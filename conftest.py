@@ -1,0 +1,39 @@
+"""Pytest root conftest: force JAX onto an 8-device virtual CPU mesh.
+
+Tests never require real TPU hardware; multi-chip sharding is validated on
+virtual CPU devices (the driver separately dry-runs the multichip path).
+Must run before jax initializes its backends, hence env vars here.
+"""
+
+import asyncio
+import inspect
+import os
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "asyncio: run the coroutine test on a fresh event loop"
+    )
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio support (pytest-asyncio is not in the image)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None

@@ -1,0 +1,23 @@
+"""dynamo-exp-tpu: a TPU-native distributed LLM serving framework.
+
+A ground-up, TPU-first (JAX / XLA / Pallas / pjit) framework with the
+capabilities of NVIDIA Dynamo (the reference at ``rmukhopa/dynamo_exp``):
+
+- distributed runtime (namespaces / components / endpoints, discovery with
+  leases, push routing, streaming response plane)
+- OpenAI-compatible HTTP frontend with SSE streaming and Prometheus metrics
+- tokenization / chat-templating preprocessor and incremental detokenizing
+  backend with stop-condition handling
+- a native JAX/TPU inference engine: continuous batching, paged KV cache in
+  HBM, Pallas paged-attention kernels, pjit/shard_map parallelism over a
+  device mesh
+- KV block manager with prefix reuse and host-memory offload tiers
+- KV-cache-aware routing (radix indexer + cost-based scheduler)
+- disaggregated prefill/decode with queue-based prefill handoff
+- planner for dynamic worker scaling
+
+The reference is Rust/CUDA/torch; this framework is an independent,
+idiomatic JAX/TPU design, not a translation.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,57 @@
+"""Distributed runtime: the accelerator-agnostic serving fabric."""
+
+from .annotated import Annotated
+from .client import Client, EngineError
+from .component import (
+    Component,
+    DistributedRuntime,
+    Endpoint,
+    Namespace,
+    ServedInstance,
+    annotated_stream,
+)
+from .config import RuntimeConfig
+from .engine import (
+    AsyncEngine,
+    AsyncEngineContext,
+    LambdaEngine,
+    ResponseStream,
+)
+from .logging import configure_logging
+from .pipeline import MapOperator, Operator, build_pipeline
+from .pool import Pool, PoolItem
+from .push_router import NoInstancesError, PushRouter, RouterMode
+from .runtime import CancellationToken, Runtime, Worker
+from .transports.base import EndpointAddress, InstanceInfo, Lease
+
+__all__ = [
+    "Annotated",
+    "AsyncEngine",
+    "AsyncEngineContext",
+    "CancellationToken",
+    "Client",
+    "Component",
+    "DistributedRuntime",
+    "Endpoint",
+    "EndpointAddress",
+    "EngineError",
+    "InstanceInfo",
+    "LambdaEngine",
+    "Lease",
+    "MapOperator",
+    "Namespace",
+    "NoInstancesError",
+    "Operator",
+    "Pool",
+    "PoolItem",
+    "PushRouter",
+    "ResponseStream",
+    "RouterMode",
+    "Runtime",
+    "RuntimeConfig",
+    "ServedInstance",
+    "Worker",
+    "annotated_stream",
+    "build_pipeline",
+    "configure_logging",
+]

@@ -1,0 +1,111 @@
+"""Endpoint client: tracks live instances and issues streaming requests.
+
+Capability parity with ``/root/reference/lib/runtime/src/component/client.rs``:
+a dynamic client watches discovery for membership changes (lease expiry
+drops instances instantly); a static client uses a fixed instance list.
+Routing policies live in :mod:`push_router`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator
+
+from .annotated import Annotated
+from .engine import AsyncEngineContext
+from .runtime import Runtime
+from .transports.base import Discovery, InstanceInfo, RequestPlane
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    def __init__(self, request_plane: RequestPlane):
+        self.request_plane = request_plane
+        self._instances: list[InstanceInfo] = []
+        self._changed = asyncio.Event()
+        self._watch_task: asyncio.Task | None = None
+
+    # --- construction -------------------------------------------------
+    @classmethod
+    def new_static(
+        cls, request_plane: RequestPlane, instances: list[InstanceInfo]
+    ) -> "Client":
+        c = cls(request_plane)
+        c._instances = list(instances)
+        c._changed.set()
+        return c
+
+    @classmethod
+    async def new_dynamic(
+        cls,
+        runtime: Runtime,
+        discovery: Discovery,
+        request_plane: RequestPlane,
+        endpoint_path: str,
+    ) -> "Client":
+        c = cls(request_plane)
+
+        async def _watch() -> None:
+            async for snapshot in discovery.watch_instances(endpoint_path):
+                c._instances = snapshot
+                c._changed.set()
+
+        c._instances = await discovery.list_instances(endpoint_path)
+        c._watch_task = runtime.spawn(_watch())
+        return c
+
+    # --- membership ---------------------------------------------------
+    @property
+    def instances(self) -> list[InstanceInfo]:
+        return self._instances
+
+    def instance_ids(self) -> list[int]:
+        return [i.instance_id for i in self._instances]
+
+    async def wait_for_instances(self, n: int = 1, timeout: float | None = None) -> None:
+        async def _wait() -> None:
+            while len(self._instances) < n:
+                self._changed.clear()
+                await self._changed.wait()
+
+        await asyncio.wait_for(_wait(), timeout)
+
+    def instance(self, instance_id: int) -> InstanceInfo:
+        for i in self._instances:
+            if i.instance_id == instance_id:
+                return i
+        raise KeyError(f"instance {instance_id} is not live")
+
+    # --- requests -----------------------------------------------------
+    async def generate_to(
+        self,
+        instance: InstanceInfo,
+        request: dict,
+        context: AsyncEngineContext | None = None,
+    ) -> AsyncIterator[Annotated]:
+        """Issue a request to one instance; yields Annotated frames.
+
+        Error frames raise ``EngineError`` so callers see remote failures
+        as exceptions unless they iterate the raw stream themselves.
+        """
+        ctx = context or AsyncEngineContext()
+        frames = await self.request_plane.request_stream(instance, request, ctx)
+
+        async def _gen() -> AsyncIterator[Annotated]:
+            async for frame in frames:
+                ann = Annotated.from_dict(frame)
+                if ann.is_error():
+                    raise EngineError(ann.error_message() or "remote engine error")
+                yield ann
+
+        return _gen()
+
+    def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+
+
+class EngineError(RuntimeError):
+    """A remote engine reported an error frame in its response stream."""

@@ -1,0 +1,250 @@
+"""Distributed runtime and the Namespace -> Component -> Endpoint hierarchy.
+
+Capability parity with the reference component model
+(``/root/reference/lib/runtime/src/component.rs:120-192`` and
+``distributed.rs:31-186``): a ``DistributedRuntime`` owns the transports;
+namespaces contain components; components expose named endpoints that are
+served over the request plane and registered in discovery under a lease,
+so that worker death removes the instance automatically.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator
+
+from .client import Client
+from .config import RuntimeConfig
+from .engine import AsyncEngineContext
+from .runtime import Runtime
+from .transports.base import (
+    Discovery,
+    EndpointAddress,
+    Handler,
+    InstanceInfo,
+    Lease,
+    RequestPlane,
+    ServedEndpoint,
+    StatsHandler,
+)
+from .transports.inproc import InProcDiscovery, InProcRequestPlane, next_instance_id
+
+logger = logging.getLogger(__name__)
+
+
+class DistributedRuntime:
+    """Runtime + cluster transports. In static mode (no coordinator
+    configured) discovery and the request plane are in-process."""
+
+    def __init__(
+        self,
+        runtime: Runtime | None = None,
+        config: RuntimeConfig | None = None,
+        discovery: Discovery | None = None,
+        request_plane: RequestPlane | None = None,
+    ):
+        self.config = config or RuntimeConfig()
+        self.runtime = runtime or Runtime(
+            num_blocking_threads=self.config.num_blocking_threads
+        )
+        if discovery is None or request_plane is None:
+            if self.config.is_static:
+                discovery = discovery or InProcDiscovery()
+                request_plane = request_plane or InProcRequestPlane()
+            else:
+                try:
+                    from .transports.coordinator import CoordinatorDiscovery
+                    from .transports.tcp import TcpRequestPlane
+                except ImportError as e:  # pragma: no cover
+                    raise NotImplementedError(
+                        "dynamic mode requires the coordinator/tcp transports; "
+                        f"this build is missing them: {e}"
+                    ) from e
+
+                discovery = discovery or CoordinatorDiscovery(
+                    self.config.coordinator_endpoint,
+                    lease_ttl_s=self.config.lease_ttl_s,
+                )
+                request_plane = request_plane or TcpRequestPlane(
+                    bind_host=self.config.response_host,
+                    bind_port=self.config.response_port,
+                )
+        self.discovery = discovery
+        self.request_plane = request_plane
+        self._namespaces: dict[str, Namespace] = {}
+        self._primary_lease: Lease | None = None
+
+    @classmethod
+    def from_settings(cls, config_path: str | None = None) -> "DistributedRuntime":
+        return cls(config=RuntimeConfig.from_settings(config_path))
+
+    @classmethod
+    def detached(cls) -> "DistributedRuntime":
+        """Static single-process runtime (no discovery services)."""
+        return cls(config=RuntimeConfig())
+
+    async def primary_lease(self) -> Lease:
+        if self._primary_lease is None or not self._primary_lease.is_valid():
+            self._primary_lease = await self.discovery.create_lease(
+                self.config.lease_ttl_s
+            )
+        return self._primary_lease
+
+    def namespace(self, name: str) -> "Namespace":
+        if name not in self._namespaces:
+            self._namespaces[name] = Namespace(self, name)
+        return self._namespaces[name]
+
+    def shutdown(self) -> None:
+        self.runtime.shutdown()
+
+    async def close(self) -> None:
+        if self._primary_lease is not None and self._primary_lease.is_valid():
+            await self._primary_lease.revoke()
+        await self.request_plane.close()
+        await self.discovery.close()
+        await self.runtime.close()
+
+
+class Namespace:
+    def __init__(self, drt: DistributedRuntime, name: str):
+        _validate_segment(name)
+        self.drt = drt
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+
+class Component:
+    """A discoverable unit of work (e.g. "worker", "router", "prefill")."""
+
+    def __init__(self, namespace: Namespace, name: str):
+        _validate_segment(name)
+        self.namespace = namespace
+        self.name = name
+
+    @property
+    def drt(self) -> DistributedRuntime:
+        return self.namespace.drt
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace.name}/components/{self.name}"
+
+    @property
+    def service_name(self) -> str:
+        return f"{self.namespace.name}_{self.name}"
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+    async def scrape_stats(self) -> dict[int, dict]:
+        """Collect live stats from every instance of this component."""
+        out: dict[int, dict] = {}
+        for info in await self.drt.discovery.list_instances(self.path):
+            try:
+                out[info.instance_id] = await self.drt.request_plane.scrape_stats(info)
+            except ConnectionError:
+                continue
+        return out
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        _validate_segment(name)
+        self.component = component
+        self.name = name
+
+    @property
+    def drt(self) -> DistributedRuntime:
+        return self.component.drt
+
+    @property
+    def address(self) -> EndpointAddress:
+        return EndpointAddress(
+            self.component.namespace.name, self.component.name, self.name
+        )
+
+    @property
+    def path(self) -> str:
+        return self.address.path
+
+    async def serve_endpoint(
+        self,
+        handler: Handler,
+        stats_handler: StatsHandler | None = None,
+        lease: Lease | None = None,
+        metadata: dict | None = None,
+    ) -> "ServedInstance":
+        """Register + serve this endpoint; returns the live instance handle."""
+        drt = self.drt
+        if lease is None:
+            lease = await drt.primary_lease()
+        # Instance ids are unique per served endpoint (NOT the lease id):
+        # one process commonly serves several endpoints under one primary
+        # lease, and they must not clobber each other in the registry.
+        info = InstanceInfo(
+            address=self.address,
+            instance_id=next_instance_id(),
+            metadata=metadata or {},
+        )
+        served = await drt.request_plane.serve(info, handler, stats_handler)
+        await drt.discovery.register_instance(info, lease)
+        logger.info("serving endpoint %s as instance %d", self.path, info.instance_id)
+        return ServedInstance(self, info, served, lease)
+
+    async def client(self, static_instances: list[InstanceInfo] | None = None) -> Client:
+        """A client that tracks this endpoint's live instances."""
+        if static_instances is not None:
+            return Client.new_static(self.drt.request_plane, static_instances)
+        return await Client.new_dynamic(
+            self.drt.runtime, self.drt.discovery, self.drt.request_plane, self.path
+        )
+
+
+class ServedInstance:
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        info: InstanceInfo,
+        served: ServedEndpoint,
+        lease: Lease,
+    ):
+        self.endpoint = endpoint
+        self.info = info
+        self._served = served
+        self.lease = lease
+
+    @property
+    def instance_id(self) -> int:
+        return self.info.instance_id
+
+    async def close(self, revoke_lease: bool = True) -> None:
+        """Stop serving: revoke lease first (drop from discovery), then
+        drain inflight requests — the reference's graceful-shutdown order."""
+        if revoke_lease and self.lease.is_valid():
+            await self.lease.revoke()
+        await self._served.close()
+
+
+def _validate_segment(name: str) -> None:
+    if not name or any(c in name for c in "./ \t\n"):
+        raise ValueError(f"invalid name segment: {name!r}")
+
+
+async def annotated_stream(
+    engine,
+    request: dict,
+    context: AsyncEngineContext | None = None,
+) -> AsyncIterator[dict]:
+    """Adapt an AsyncEngine of dicts into an Annotated-frame handler stream."""
+    from .annotated import Annotated
+
+    ctx = context or AsyncEngineContext()
+    try:
+        stream = await engine.generate(request, ctx)
+        async for item in stream:
+            yield Annotated.from_data(item).to_dict()
+    except Exception as e:  # error frames travel in-band
+        yield Annotated.from_error(str(e)).to_dict()

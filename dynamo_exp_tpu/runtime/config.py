@@ -1,0 +1,76 @@
+"""Layered runtime configuration: defaults <- config file <- environment.
+
+Reference capability: figment layering in
+``/root/reference/lib/runtime/src/config.rs:26-146`` with ``DYN_RUNTIME_*``
+env prefixes. We keep the same shape: a dataclass of defaults, optionally
+overridden by a YAML/JSON file, then by ``DYN_*`` environment variables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+TRUTHY = {"1", "true", "yes", "on"}
+
+
+def env_is_truthy(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in TRUTHY
+
+
+@dataclass
+class RuntimeConfig:
+    """Process-level runtime settings."""
+
+    num_blocking_threads: int = 8
+    # Control-plane (coordinator) and request-plane (broker) addresses.
+    # Empty => "static" mode: no discovery, endpoints wired explicitly.
+    coordinator_endpoint: str = ""
+    broker_endpoint: str = ""
+    # TCP response-plane bind host (the address handed to peers).
+    response_host: str = "127.0.0.1"
+    response_port: int = 0  # 0 = ephemeral
+    # Lease TTL for discovery registrations, seconds.
+    lease_ttl_s: float = 10.0
+    log_jsonl: bool = False
+    log_level: str = "INFO"
+
+    ENV_PREFIX = "DYN_RUNTIME_"
+
+    @classmethod
+    def from_settings(cls, config_path: str | None = None) -> "RuntimeConfig":
+        values: dict[str, Any] = {}
+        path = config_path or os.environ.get("DYN_RUNTIME_CONFIG")
+        if path and Path(path).exists():
+            text = Path(path).read_text()
+            if path.endswith((".yaml", ".yml")):
+                import yaml
+
+                values.update(yaml.safe_load(text) or {})
+            else:
+                values.update(json.loads(text))
+        for f in dataclasses.fields(cls):
+            if f.name == "ENV_PREFIX":
+                continue
+            env_name = cls.ENV_PREFIX + f.name.upper()
+            if env_name in os.environ:
+                raw = os.environ[env_name]
+                if f.type in ("int", int):
+                    values[f.name] = int(raw)
+                elif f.type in ("float", float):
+                    values[f.name] = float(raw)
+                elif f.type in ("bool", bool):
+                    values[f.name] = raw.strip().lower() in TRUTHY
+                else:
+                    values[f.name] = raw
+        known = {f.name for f in dataclasses.fields(cls)}
+        values = {k: v for k, v in values.items() if k in known}
+        return cls(**values)
+
+    @property
+    def is_static(self) -> bool:
+        return not self.coordinator_endpoint

@@ -1,0 +1,52 @@
+"""Structured logging: human-readable or JSONL, env-configurable.
+
+Reference capability: ``/root/reference/lib/runtime/src/logging.rs:15-344``
+(READABLE vs JSONL via env, level filters). Controlled here by
+``DYN_LOG`` (level) and ``DYN_LOGGING_JSONL`` (format).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info and record.exc_info[0] is not None:
+            entry["exception"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+def configure_logging(
+    level: str | None = None, jsonl: bool | None = None, stream=None
+) -> None:
+    level = (level or os.environ.get("DYN_LOG", "INFO")).upper()
+    if jsonl is None:
+        jsonl = os.environ.get("DYN_LOGGING_JSONL", "").lower() in {"1", "true", "yes"}
+    handler = logging.StreamHandler(stream or sys.stderr)
+    if jsonl:
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)-5s %(name)s: %(message)s",
+                datefmt="%Y-%m-%dT%H:%M:%S",
+            )
+        )
+    root = logging.getLogger()
+    root.handlers = [handler]
+    try:
+        root.setLevel(level)
+    except ValueError:
+        root.setLevel(logging.INFO)

@@ -1,0 +1,85 @@
+"""Composable pipeline: operators chained in front of a sink engine.
+
+Capability parity with the reference pipeline graph
+(``/root/reference/lib/runtime/src/pipeline/nodes.rs``): a request flows
+frontend -> operator(s) -> backend; each operator can transform the
+request on the way down and the response stream on the way up. In JAX
+terms this is just function composition over AsyncEngines, so the Python
+shape is small.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, AsyncIterator
+
+from .engine import AsyncEngine, AsyncEngineContext, ResponseStream
+
+
+class Context:
+    """Per-request context bag propagated through the pipeline (request id,
+    annotations requested by the client, arbitrary values)."""
+
+    def __init__(self, request_id: str | None = None):
+        self.engine_context = AsyncEngineContext(request_id)
+        self.values: dict[str, Any] = {}
+
+    @property
+    def id(self) -> str:
+        return self.engine_context.id
+
+
+class Operator(abc.ABC):
+    """A bidirectional transform stage."""
+
+    @abc.abstractmethod
+    async def generate(
+        self,
+        request: Any,
+        next_engine: AsyncEngine,
+        context: AsyncEngineContext,
+    ) -> ResponseStream: ...
+
+
+class _OperatorEngine(AsyncEngine):
+    def __init__(self, op: Operator, next_engine: AsyncEngine):
+        self._op = op
+        self._next = next_engine
+
+    async def generate(
+        self, request: Any, context: AsyncEngineContext | None = None
+    ) -> ResponseStream:
+        ctx = context or AsyncEngineContext()
+        return await self._op.generate(request, self._next, ctx)
+
+
+def build_pipeline(operators: list[Operator], sink: AsyncEngine) -> AsyncEngine:
+    """Chain operators (first = outermost) in front of ``sink``."""
+    engine = sink
+    for op in reversed(operators):
+        engine = _OperatorEngine(op, engine)
+    return engine
+
+
+class MapOperator(Operator):
+    """Stateless operator from two plain functions (request map, item map)."""
+
+    def __init__(self, map_request=None, map_response_item=None):
+        self._map_req = map_request
+        self._map_item = map_response_item
+
+    async def generate(
+        self,
+        request: Any,
+        next_engine: AsyncEngine,
+        context: AsyncEngineContext,
+    ) -> ResponseStream:
+        if self._map_req is not None:
+            request = self._map_req(request)
+        stream = await next_engine.generate(request, context)
+
+        async def _gen() -> AsyncIterator[Any]:
+            async for item in stream:
+                yield self._map_item(item) if self._map_item else item
+
+        return ResponseStream(_gen(), context)

@@ -1,0 +1,84 @@
+"""PushRouter: policy-based dispatch over a Client's live instances.
+
+Capability parity with
+``/root/reference/lib/runtime/src/pipeline/network/egress/push_router.rs``:
+random / round-robin / direct(instance) / static routing, presented as an
+AsyncEngine so routers compose with pipelines. KV-aware routing lives in
+:mod:`dynamo_exp_tpu.router` and plugs in via ``RouterMode.DIRECT``.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import random
+from typing import Any, AsyncIterator
+
+from .client import Client
+from .engine import AsyncEngine, AsyncEngineContext, ResponseStream
+from .transports.base import InstanceInfo
+
+
+class RouterMode(enum.Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round-robin"
+    DIRECT = "direct"
+    STATIC = "static"
+    KV = "kv"
+
+
+class NoInstancesError(ConnectionError):
+    pass
+
+
+class PushRouter(AsyncEngine[dict, Any]):
+    """Routes each request to one live instance of a remote endpoint."""
+
+    def __init__(self, client: Client, mode: RouterMode = RouterMode.RANDOM):
+        self.client = client
+        self.mode = mode
+        self._rr = itertools.count()
+
+    def _pick(self, request: dict) -> InstanceInfo:
+        instances = self.client.instances
+        if not instances:
+            raise NoInstancesError("no live instances for endpoint")
+        # An explicit target always wins, regardless of mode.
+        if "_worker_instance_id" in request:
+            return self.client.instance(int(request["_worker_instance_id"]))
+        if self.mode is RouterMode.RANDOM:
+            return random.choice(instances)
+        if self.mode is RouterMode.ROUND_ROBIN:
+            return instances[next(self._rr) % len(instances)]
+        if self.mode in (RouterMode.DIRECT, RouterMode.KV):
+            worker_id = request.get("_worker_instance_id")
+            if worker_id is None:
+                raise ValueError("direct routing requires _worker_instance_id")
+            return self.client.instance(int(worker_id))
+        # STATIC: single fixed instance
+        return instances[0]
+
+    async def generate(
+        self, request: dict, context: AsyncEngineContext | None = None
+    ) -> ResponseStream[Any]:
+        ctx = context or AsyncEngineContext()
+        instance = self._pick(request)
+        request = {k: v for k, v in request.items() if k != "_worker_instance_id"}
+        frames = await self.client.generate_to(instance, request, ctx)
+
+        async def _data() -> AsyncIterator[Any]:
+            async for ann in frames:
+                if ann.data is not None:
+                    yield ann.data
+
+        return ResponseStream(_data(), ctx)
+
+    async def generate_direct(
+        self,
+        request: dict,
+        instance_id: int,
+        context: AsyncEngineContext | None = None,
+    ) -> ResponseStream[Any]:
+        return await self.generate(
+            {**request, "_worker_instance_id": instance_id}, context
+        )

@@ -1,0 +1,27 @@
+"""Transport implementations for discovery and the request/response planes."""
+
+from .base import (
+    Discovery,
+    EndpointAddress,
+    Handler,
+    InstanceInfo,
+    Lease,
+    RequestPlane,
+    ServedEndpoint,
+    StatsHandler,
+)
+from .inproc import InProcDiscovery, InProcRequestPlane, LatencyModel
+
+__all__ = [
+    "Discovery",
+    "EndpointAddress",
+    "Handler",
+    "InProcDiscovery",
+    "InProcRequestPlane",
+    "InstanceInfo",
+    "LatencyModel",
+    "Lease",
+    "RequestPlane",
+    "ServedEndpoint",
+    "StatsHandler",
+]

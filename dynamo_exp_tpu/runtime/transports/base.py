@@ -1,0 +1,175 @@
+"""Transport interfaces: discovery (control plane) and request plane.
+
+The reference splits its distributed fabric into planes
+(``/root/reference/lib/runtime/src/transports/``): etcd for
+discovery/leases/watches, NATS for the request push plane, raw TCP for
+response streams. We keep the same plane split behind two small
+interfaces so the whole stack runs either fully in-process (static mode,
+unit tests) or over our self-hosted coordinator + TCP planes — no external
+etcd/NATS services required.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from ..engine import AsyncEngineContext
+
+# A served endpoint handler: request dict -> stream of Annotated dicts.
+Handler = Callable[[dict, AsyncEngineContext], AsyncIterator[dict]]
+# A stats handler: () -> metrics dict (merged into the instance's stats).
+StatsHandler = Callable[[], dict]
+
+
+@dataclass(frozen=True)
+class EndpointAddress:
+    """Hierarchical endpoint id: ``{namespace}/components/{component}/{name}``."""
+
+    namespace: str
+    component: str
+    name: str
+
+    @property
+    def subject(self) -> str:
+        return f"{self.namespace}.{self.component}.{self.name}"
+
+    @property
+    def path(self) -> str:
+        return f"{self.namespace}/components/{self.component}/endpoints/{self.name}"
+
+    @classmethod
+    def from_url(cls, url: str) -> "EndpointAddress":
+        """Parse ``dyn://ns.component.endpoint``."""
+        body = url.removeprefix("dyn://")
+        parts = body.split(".")
+        if len(parts) != 3:
+            raise ValueError(f"expected dyn://ns.component.endpoint, got {url!r}")
+        return cls(*parts)
+
+
+@dataclass
+class InstanceInfo:
+    """One live instance of an endpoint, as published to discovery."""
+
+    address: EndpointAddress
+    instance_id: int
+    transport: str = "inproc"  # "inproc" | "tcp"
+    transport_address: str = ""  # host:port for tcp
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "namespace": self.address.namespace,
+            "component": self.address.component,
+            "name": self.address.name,
+            "instance_id": self.instance_id,
+            "transport": self.transport,
+            "transport_address": self.transport_address,
+            "metadata": self.metadata,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InstanceInfo":
+        return cls(
+            address=EndpointAddress(d["namespace"], d["component"], d["name"]),
+            instance_id=d["instance_id"],
+            transport=d.get("transport", "inproc"),
+            transport_address=d.get("transport_address", ""),
+            metadata=d.get("metadata", {}),
+        )
+
+
+class Lease(abc.ABC):
+    """A liveness lease; revoking (or process death) removes registrations."""
+
+    @property
+    @abc.abstractmethod
+    def lease_id(self) -> int: ...
+
+    @abc.abstractmethod
+    async def revoke(self) -> None: ...
+
+    @abc.abstractmethod
+    def is_valid(self) -> bool: ...
+
+
+class Discovery(abc.ABC):
+    """Control plane: endpoint registry with leases + watches, and a small
+    KV store with watch support (model entries, disagg config)."""
+
+    @abc.abstractmethod
+    async def register_instance(self, info: InstanceInfo, lease: Lease | None = None) -> Lease: ...
+
+    @abc.abstractmethod
+    async def create_lease(self, ttl_s: float | None = None) -> Lease: ...
+
+    @abc.abstractmethod
+    async def list_instances(self, prefix: str) -> list[InstanceInfo]: ...
+
+    @abc.abstractmethod
+    def watch_instances(self, prefix: str) -> "AsyncIterator[list[InstanceInfo]]":
+        """Yields the full live-instance snapshot on every membership change
+        (first yield is the current snapshot)."""
+
+    # --- generic KV with watch (etcd-style) ---
+    @abc.abstractmethod
+    async def kv_put(self, key: str, value: bytes, lease: Lease | None = None) -> None: ...
+
+    @abc.abstractmethod
+    async def kv_create(self, key: str, value: bytes, lease: Lease | None = None) -> bool:
+        """Create-if-absent; returns False if the key already exists."""
+
+    @abc.abstractmethod
+    async def kv_get(self, key: str) -> bytes | None: ...
+
+    @abc.abstractmethod
+    async def kv_get_prefix(self, prefix: str) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    async def kv_delete(self, key: str) -> None: ...
+
+    @abc.abstractmethod
+    def kv_watch_prefix(self, prefix: str) -> "AsyncIterator[dict[str, bytes]]":
+        """Yields the full prefix snapshot on every change (first yield is
+        the current snapshot)."""
+
+    async def close(self) -> None:  # pragma: no cover - default no-op
+        return None
+
+
+class ServedEndpoint(abc.ABC):
+    """Handle for a serving endpoint; close() drains gracefully."""
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
+class RequestPlane(abc.ABC):
+    """Request push + streaming response plane."""
+
+    @abc.abstractmethod
+    async def serve(
+        self, info: InstanceInfo, handler: Handler, stats_handler: StatsHandler | None = None
+    ) -> ServedEndpoint: ...
+
+    @abc.abstractmethod
+    async def request_stream(
+        self,
+        instance: InstanceInfo,
+        request: dict,
+        context: AsyncEngineContext,
+    ) -> AsyncIterator[dict]:
+        """Send one request to one instance; returns the Annotated-frame
+        stream. Cancelling ``context`` propagates upstream."""
+
+    @abc.abstractmethod
+    async def scrape_stats(self, instance: InstanceInfo) -> dict:
+        """Fetch the instance's live stats (load metrics)."""
+
+    async def close(self) -> None:  # pragma: no cover - default no-op
+        return None
+
+
+RequestHook = Callable[[dict], Awaitable[None]]

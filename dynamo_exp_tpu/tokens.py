@@ -1,0 +1,190 @@
+"""Token sequences, fixed-size token blocks, and chained block hashing.
+
+This is the foundation shared by the KV-aware router (prefix matching over
+block hashes) and the KV block manager (content-addressed block reuse).
+
+Capability parity with the reference's token/block layer
+(``/root/reference/lib/tokens/src/lib.rs:44-369`` and
+``lib/llm/src/tokens.rs``): fixed-size blocks of token ids, a per-block
+*local* hash over the block's tokens, and a *sequence hash* chaining each
+block to its prefix so equal sequence hashes imply equal full prefixes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Sequence
+
+import xxhash
+
+# Salt seeds the first block's chain so that hashes from different
+# deployments/configurations don't collide by construction.
+DEFAULT_HASH_SEED = 1337
+
+
+def compute_block_hash(tokens: Sequence[int], seed: int = DEFAULT_HASH_SEED) -> int:
+    """Hash one block's tokens (local hash, not chained)."""
+    h = xxhash.xxh3_64(seed=seed)
+    for t in tokens:
+        h.update(int(t).to_bytes(4, "little", signed=False))
+    return h.intdigest()
+
+
+def chain_hash(parent: int | None, local: int, seed: int = DEFAULT_HASH_SEED) -> int:
+    """Chain a block's local hash onto its prefix's sequence hash."""
+    h = xxhash.xxh3_64(seed=seed)
+    if parent is not None:
+        h.update(int(parent).to_bytes(8, "little", signed=False))
+    h.update(int(local).to_bytes(8, "little", signed=False))
+    return h.intdigest()
+
+
+def compute_block_hashes_for_seq(
+    tokens: Sequence[int], block_size: int, seed: int = DEFAULT_HASH_SEED
+) -> list[int]:
+    """Sequence hashes for every *complete* block of ``tokens``.
+
+    This is what the router hashes incoming requests with (reference:
+    ``lib/llm/src/kv_router/indexer.rs:123`` ``compute_block_hash_for_seq``).
+    """
+    hashes: list[int] = []
+    parent: int | None = None
+    for start in range(0, len(tokens) - block_size + 1, block_size):
+        local = compute_block_hash(tokens[start : start + block_size], seed)
+        parent = chain_hash(parent, local, seed)
+        hashes.append(parent)
+    return hashes
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """An immutable, complete block of ``block_size`` tokens.
+
+    ``sequence_hash`` identifies the full token prefix ending at this block;
+    ``block_hash`` is the local (unchained) hash of just this block.
+    """
+
+    tokens: tuple[int, ...]
+    block_hash: int
+    sequence_hash: int
+    parent_sequence_hash: int | None
+
+    @property
+    def block_size(self) -> int:
+        return len(self.tokens)
+
+
+@dataclass
+class PartialTokenBlock:
+    """The mutable tail block currently being filled."""
+
+    block_size: int
+    seed: int = DEFAULT_HASH_SEED
+    tokens: list[int] = field(default_factory=list)
+    parent_sequence_hash: int | None = None
+
+    @property
+    def remaining(self) -> int:
+        return self.block_size - len(self.tokens)
+
+    def push(self, token: int) -> TokenBlock | None:
+        """Append one token; returns the completed block when full."""
+        self.tokens.append(int(token))
+        if len(self.tokens) < self.block_size:
+            return None
+        local = compute_block_hash(self.tokens, self.seed)
+        seq = chain_hash(self.parent_sequence_hash, local, self.seed)
+        block = TokenBlock(
+            tokens=tuple(self.tokens),
+            block_hash=local,
+            sequence_hash=seq,
+            parent_sequence_hash=self.parent_sequence_hash,
+        )
+        self.tokens = []
+        self.parent_sequence_hash = seq
+        return block
+
+
+class TokenBlockSequence:
+    """A growing token sequence chunked into hash-chained blocks.
+
+    Mirrors the capability of the reference's ``TokenBlockSequence``
+    (``lib/tokens/src/lib.rs:277-369``): push tokens one at a time, get a
+    callback/event whenever a block completes (used by the engine's cache
+    manager to emit KV "stored" events), and expose all completed blocks.
+    """
+
+    def __init__(
+        self,
+        tokens: Iterable[int] = (),
+        block_size: int = 64,
+        seed: int = DEFAULT_HASH_SEED,
+        on_block: Callable[[TokenBlock], None] | None = None,
+    ):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = block_size
+        self.seed = seed
+        self._on_block = on_block
+        self._blocks: list[TokenBlock] = []
+        self._partial = PartialTokenBlock(block_size=block_size, seed=seed)
+        self._count = 0
+        self.extend(tokens)
+
+    @property
+    def blocks(self) -> list[TokenBlock]:
+        return self._blocks
+
+    @property
+    def partial_tokens(self) -> list[int]:
+        return self._partial.tokens
+
+    def __len__(self) -> int:
+        return self._count
+
+    def all_tokens(self) -> list[int]:
+        out: list[int] = []
+        for b in self._blocks:
+            out.extend(b.tokens)
+        out.extend(self._partial.tokens)
+        return out
+
+    def block_hashes(self) -> list[int]:
+        """Chained sequence hashes of all completed blocks."""
+        return [b.sequence_hash for b in self._blocks]
+
+    def push(self, token: int) -> TokenBlock | None:
+        self._count += 1
+        block = self._partial.push(token)
+        if block is not None:
+            self._blocks.append(block)
+            if self._on_block is not None:
+                self._on_block(block)
+        return block
+
+    def extend(self, tokens: Iterable[int]) -> list[TokenBlock]:
+        completed = []
+        for t in tokens:
+            b = self.push(t)
+            if b is not None:
+                completed.append(b)
+        return completed
+
+    def truncate(self, num_tokens: int) -> None:
+        """Truncate the sequence to ``num_tokens`` (e.g. on preemption).
+
+        Does NOT re-fire ``on_block`` for blocks that remain complete — the
+        cache manager already recorded them; replaying "stored" events would
+        corrupt the router's index.
+        """
+        if num_tokens > self._count:
+            raise ValueError(f"cannot truncate {self._count} tokens to {num_tokens}")
+        tokens = self.all_tokens()[:num_tokens]
+        self._blocks = []
+        self._partial = PartialTokenBlock(block_size=self.block_size, seed=self.seed)
+        self._count = 0
+        on_block, self._on_block = self._on_block, None
+        try:
+            self.extend(tokens)
+        finally:
+            self._on_block = on_block

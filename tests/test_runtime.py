@@ -1,0 +1,244 @@
+"""Tests for the runtime core: cancellation, components, routing, pipeline."""
+
+import asyncio
+
+import pytest
+
+from dynamo_exp_tpu.runtime import (
+    Annotated,
+    AsyncEngineContext,
+    CancellationToken,
+    DistributedRuntime,
+    EngineError,
+    LambdaEngine,
+    MapOperator,
+    Pool,
+    PushRouter,
+    RouterMode,
+    Runtime,
+    annotated_stream,
+    build_pipeline,
+)
+
+
+# --- cancellation ------------------------------------------------------
+@pytest.mark.asyncio
+async def test_cancellation_token_hierarchy():
+    root = CancellationToken()
+    child = root.child_token()
+    grandchild = child.child_token()
+    assert not grandchild.is_cancelled()
+    root.cancel()
+    assert child.is_cancelled() and grandchild.is_cancelled()
+
+
+@pytest.mark.asyncio
+async def test_run_until_cancelled_aborts():
+    token = CancellationToken()
+
+    async def forever():
+        await asyncio.sleep(100)
+        return "done"
+
+    task = asyncio.ensure_future(token.run_until_cancelled(forever()))
+    await asyncio.sleep(0.01)
+    token.cancel()
+    assert await task is None
+
+
+# --- component model ---------------------------------------------------
+async def echo_handler(request, context):
+    for tok in request["tokens"]:
+        yield Annotated.from_data({"token": tok}).to_dict()
+
+
+@pytest.mark.asyncio
+async def test_serve_and_call_endpoint():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("test").component("worker").endpoint("generate")
+    served = await ep.serve_endpoint(echo_handler)
+    client = await ep.client()
+    await client.wait_for_instances(1, timeout=2)
+
+    router = PushRouter(client, RouterMode.RANDOM)
+    stream = await router.generate({"tokens": [1, 2, 3]})
+    out = [item["token"] async for item in stream]
+    assert out == [1, 2, 3]
+    await served.close()
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_lease_revoke_removes_instance():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("test").component("worker").endpoint("generate")
+    served = await ep.serve_endpoint(echo_handler)
+    client = await ep.client()
+    await client.wait_for_instances(1, timeout=2)
+    await served.lease.revoke()
+    await asyncio.sleep(0.02)
+    assert client.instances == []
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_round_robin_spreads_requests():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("test").component("worker").endpoint("generate")
+    hits = {1: 0, 2: 0}
+
+    def make_handler(wid):
+        async def handler(request, context):
+            hits[wid] += 1
+            yield Annotated.from_data({"worker": wid}).to_dict()
+
+        return handler
+
+    lease_a = await drt.discovery.create_lease()
+    lease_b = await drt.discovery.create_lease()
+    await ep.serve_endpoint(make_handler(1), lease=lease_a)
+    await ep.serve_endpoint(make_handler(2), lease=lease_b)
+    client = await ep.client()
+    await client.wait_for_instances(2, timeout=2)
+    router = PushRouter(client, RouterMode.ROUND_ROBIN)
+    for _ in range(4):
+        stream = await router.generate({"tokens": []})
+        async for _ in stream:
+            pass
+    assert hits[1] == 2 and hits[2] == 2
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_direct_routing():
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("test").component("worker").endpoint("generate")
+
+    def make_handler(wid):
+        async def handler(request, context):
+            yield Annotated.from_data({"worker": wid}).to_dict()
+
+        return handler
+
+    a = await ep.serve_endpoint(make_handler("a"), lease=await drt.discovery.create_lease())
+    await ep.serve_endpoint(make_handler("b"), lease=await drt.discovery.create_lease())
+    client = await ep.client()
+    await client.wait_for_instances(2, timeout=2)
+    router = PushRouter(client, RouterMode.DIRECT)
+    stream = await router.generate_direct({"tokens": []}, a.instance_id)
+    out = [item async for item in stream]
+    assert out == [{"worker": "a"}]
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_error_frames_raise_engine_error():
+    async def failing(request, context):
+        yield Annotated.from_data({"ok": 1}).to_dict()
+        yield Annotated.from_error("boom").to_dict()
+
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("test").component("worker").endpoint("generate")
+    await ep.serve_endpoint(failing)
+    client = await ep.client()
+    await client.wait_for_instances(1, timeout=2)
+    router = PushRouter(client)
+    stream = await router.generate({})
+    with pytest.raises(EngineError, match="boom"):
+        async for _ in stream:
+            pass
+    await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_annotated_stream_wraps_engine_errors():
+    async def explode(request, ctx):
+        raise ValueError("engine exploded")
+        yield  # pragma: no cover
+
+    engine = LambdaEngine(explode)
+    frames = [f async for f in annotated_stream(engine, {})]
+    assert Annotated.from_dict(frames[-1]).is_error()
+
+
+@pytest.mark.asyncio
+async def test_scrape_stats():
+    drt = DistributedRuntime.detached()
+    comp = drt.namespace("test").component("worker")
+    await comp.endpoint("generate").serve_endpoint(
+        echo_handler, stats_handler=lambda: {"kv_active_blocks": 5}
+    )
+    stats = await comp.scrape_stats()
+    assert len(stats) == 1
+    (s,) = stats.values()
+    assert s["kv_active_blocks"] == 5
+    await drt.close()
+
+
+# --- pipeline ----------------------------------------------------------
+@pytest.mark.asyncio
+async def test_pipeline_composition():
+    async def sink_gen(request, ctx):
+        for t in request["tokens"]:
+            yield t
+
+    sink = LambdaEngine(sink_gen)
+    double_in = MapOperator(map_request=lambda r: {"tokens": [t * 2 for t in r["tokens"]]})
+    plus_one_out = MapOperator(map_response_item=lambda t: t + 1)
+    engine = build_pipeline([plus_one_out, double_in], sink)
+    stream = await engine.generate({"tokens": [1, 2, 3]})
+    assert [t async for t in stream] == [3, 5, 7]
+
+
+# --- pool --------------------------------------------------------------
+@pytest.mark.asyncio
+async def test_pool_acquire_release():
+    pool = Pool([1, 2])
+    a = await pool.acquire()
+    b = await pool.acquire()
+    assert pool.available == 0
+    waiter = asyncio.ensure_future(pool.acquire())
+    await asyncio.sleep(0.01)
+    assert not waiter.done()
+    a.release()
+    c = await asyncio.wait_for(waiter, 1)
+    assert c.value == a._value if hasattr(a, "_value") else True
+    b.release()
+    c.release()
+    assert pool.available == 2
+
+
+@pytest.mark.asyncio
+async def test_runtime_blocking_and_shutdown():
+    rt = Runtime(num_blocking_threads=2)
+    assert await rt.run_blocking(lambda: 42) == 42
+    rt.shutdown()
+    assert rt.is_shutdown()
+    await rt.close()
+
+
+@pytest.mark.asyncio
+async def test_two_endpoints_share_primary_lease_without_clobbering():
+    """Regression: serving two endpoints under the default (shared primary)
+    lease must not overwrite each other's handler or discovery entry."""
+    drt = DistributedRuntime.detached()
+    comp = drt.namespace("test").component("worker")
+
+    async def gen_handler(request, ctx):
+        yield Annotated.from_data("gen").to_dict()
+
+    async def stats_handler(request, ctx):
+        yield Annotated.from_data("stats").to_dict()
+
+    await comp.endpoint("generate").serve_endpoint(gen_handler)
+    await comp.endpoint("load_metrics").serve_endpoint(stats_handler)
+
+    c1 = await comp.endpoint("generate").client()
+    c2 = await comp.endpoint("load_metrics").client()
+    await c1.wait_for_instances(1, timeout=2)
+    await c2.wait_for_instances(1, timeout=2)
+    s1 = await PushRouter(c1).generate({})
+    s2 = await PushRouter(c2).generate({})
+    assert [x async for x in s1] == ["gen"]
+    assert [x async for x in s2] == ["stats"]
+    await drt.close()

@@ -1,0 +1,76 @@
+"""Tests for token blocks and chained hashing."""
+
+from dynamo_exp_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_block_hashes_for_seq,
+)
+
+
+def test_block_completion_and_partial():
+    seq = TokenBlockSequence(block_size=4)
+    completed = seq.extend([1, 2, 3])
+    assert completed == []
+    assert seq.partial_tokens == [1, 2, 3]
+    block = seq.push(4)
+    assert block is not None
+    assert block.tokens == (1, 2, 3, 4)
+    assert seq.partial_tokens == []
+    assert len(seq) == 4
+
+
+def test_sequence_hash_chains_prefix():
+    a = TokenBlockSequence(range(8), block_size=4)
+    b = TokenBlockSequence(list(range(4)) + [9, 9, 9, 9], block_size=4)
+    # Same first block -> same first sequence hash.
+    assert a.blocks[0].sequence_hash == b.blocks[0].sequence_hash
+    # Different second block -> different chained hash.
+    assert a.blocks[1].sequence_hash != b.blocks[1].sequence_hash
+    # Chained hash differs from local hash of the same content.
+    assert a.blocks[1].sequence_hash != a.blocks[1].block_hash
+
+
+def test_same_block_content_different_prefix_differs():
+    # Block [5,6,7,8] appears at position 1 in both, but prefixes differ.
+    a = TokenBlockSequence([1, 2, 3, 4, 5, 6, 7, 8], block_size=4)
+    b = TokenBlockSequence([9, 9, 9, 9, 5, 6, 7, 8], block_size=4)
+    assert a.blocks[1].block_hash == b.blocks[1].block_hash
+    assert a.blocks[1].sequence_hash != b.blocks[1].sequence_hash
+
+
+def test_compute_block_hashes_for_seq_matches_sequence():
+    tokens = list(range(300))
+    seq = TokenBlockSequence(tokens, block_size=64)
+    assert compute_block_hashes_for_seq(tokens, 64) == seq.block_hashes()
+    assert len(seq.block_hashes()) == 4  # 300 // 64
+
+
+def test_on_block_callback():
+    events = []
+    seq = TokenBlockSequence(block_size=2, on_block=events.append)
+    seq.extend([1, 2, 3, 4, 5])
+    assert [b.tokens for b in events] == [(1, 2), (3, 4)]
+
+
+def test_truncate():
+    seq = TokenBlockSequence(range(10), block_size=4)
+    seq.truncate(6)
+    assert len(seq) == 6
+    assert len(seq.blocks) == 1
+    assert seq.partial_tokens == [4, 5]
+    # Hashes are recomputed consistently.
+    assert seq.blocks[0].sequence_hash == TokenBlockSequence(range(4), block_size=4).blocks[0].sequence_hash
+
+
+def test_hash_seed_matters():
+    assert compute_block_hash([1, 2, 3], seed=1) != compute_block_hash([1, 2, 3], seed=2)
+
+
+def test_truncate_does_not_replay_on_block_events():
+    events = []
+    seq = TokenBlockSequence(range(8), block_size=4, on_block=events.append)
+    assert len(events) == 2
+    seq.truncate(6)
+    assert len(events) == 2  # no replayed "stored" events
+    seq.extend([6, 7])
+    assert len(events) == 3  # but new completions still fire
