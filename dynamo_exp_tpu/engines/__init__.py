@@ -1,0 +1,40 @@
+"""Engine registry: test engines and the TPU engine behind one seam.
+
+Capability parity with ``/root/reference/lib/llm/src/engines.rs``: "core"
+engines speak token-in/token-out (``BackendInput`` -> ``LLMEngineOutput``)
+and get wrapped by the preprocessor + backend; "full" engines accept
+OpenAI requests directly. ``MultiNodeConfig`` carries multi-host bring-up
+parameters (JAX distributed coordinator instead of Ray/torch.distributed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .echo import EchoEngineCore, EchoEngineFull
+
+
+@dataclass
+class MultiNodeConfig:
+    """Multi-host engine bring-up (maps to jax.distributed.initialize)."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    coordinator_address: str = ""
+
+
+def make_engine(name: str, **kwargs):
+    """Engine factory by name. ``jax`` is the native TPU engine; the echo
+    engines validate the serving pipeline without hardware."""
+    if name == "echo_core":
+        return EchoEngineCore(**kwargs)
+    if name == "echo_full":
+        return EchoEngineFull(**kwargs)
+    if name == "jax":
+        from ..engine import TpuEngine
+
+        return TpuEngine.build(**kwargs)
+    raise ValueError(f"unknown engine {name!r}")
+
+
+__all__ = ["EchoEngineCore", "EchoEngineFull", "MultiNodeConfig", "make_engine"]

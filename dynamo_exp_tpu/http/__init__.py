@@ -1,0 +1,6 @@
+"""OpenAI-compatible HTTP ingress."""
+
+from .metrics import ServiceMetrics
+from .service import HttpService, ModelManager, build_pipeline_engine
+
+__all__ = ["HttpService", "ModelManager", "ServiceMetrics", "build_pipeline_engine"]

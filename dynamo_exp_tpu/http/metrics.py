@@ -1,0 +1,91 @@
+"""Prometheus metrics for the HTTP service.
+
+Capability parity with ``/root/reference/lib/llm/src/http/service/metrics.rs``:
+request counters / duration histograms by model+endpoint+status, inflight
+gauges, exposed on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class ServiceMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.requests_total = Counter(
+            "llm_http_service_requests_total",
+            "Total HTTP requests",
+            ["model", "endpoint", "request_type", "status"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            "llm_http_service_request_duration_seconds",
+            "End-to-end request duration",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            "llm_http_service_inflight_requests",
+            "Currently executing requests",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.time_to_first_token = Histogram(
+            "llm_http_service_time_to_first_token_seconds",
+            "TTFT for streaming requests",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def track(self, model: str, endpoint: str, request_type: str) -> "RequestTracker":
+        return RequestTracker(self, model, endpoint, request_type)
+
+
+class RequestTracker:
+    """Context manager recording one request's metrics."""
+
+    def __init__(self, metrics: ServiceMetrics, model: str, endpoint: str, request_type: str):
+        self._m = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self.request_type = request_type
+        self._start = 0.0
+        self._first_token_seen = False
+        self.status = "success"
+
+    def __enter__(self) -> "RequestTracker":
+        self._start = time.monotonic()
+        self._m.inflight.labels(self.model, self.endpoint).inc()
+        return self
+
+    def first_token(self) -> None:
+        if not self._first_token_seen:
+            self._first_token_seen = True
+            self._m.time_to_first_token.labels(self.model, self.endpoint).observe(
+                time.monotonic() - self._start
+            )
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None and self.status == "success":
+            self.status = "error"
+        self._m.inflight.labels(self.model, self.endpoint).dec()
+        self._m.requests_total.labels(
+            self.model, self.endpoint, self.request_type, self.status
+        ).inc()
+        self._m.request_duration.labels(self.model, self.endpoint).observe(
+            time.monotonic() - self._start
+        )

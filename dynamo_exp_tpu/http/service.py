@@ -1,0 +1,280 @@
+"""OpenAI-compatible HTTP ingress (aiohttp).
+
+Capability parity with ``/root/reference/lib/llm/src/http/service/``:
+``/v1/chat/completions``, ``/v1/completions``, ``/v1/models``, ``/metrics``,
+``/health``; always streams from the engine, aggregates for
+``stream=false``; per-model engine registry with dynamic attach/detach;
+client disconnect kills the request context.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from ..protocols.aggregator import aggregate_chat_stream, aggregate_completion_stream
+from ..protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    CompletionChunk,
+    CompletionRequest,
+    ModelInfo,
+    ModelList,
+)
+from ..preprocessor.preprocessor import PromptTooLongError
+from ..protocols.sse import encode_done, encode_frame
+from ..runtime.annotated import Annotated
+from ..runtime.engine import AsyncEngine, AsyncEngineContext
+from .metrics import CONTENT_TYPE_LATEST, ServiceMetrics
+
+logger = logging.getLogger(__name__)
+
+
+class ModelManager:
+    """Per-model engine registry with dynamic attach/detach."""
+
+    def __init__(self):
+        self._chat: dict[str, AsyncEngine] = {}
+        self._completion: dict[str, AsyncEngine] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine) -> None:
+        self._chat[name] = engine
+
+    def add_completion_model(self, name: str, engine: AsyncEngine) -> None:
+        self._completion[name] = engine
+
+    def remove_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+        self._completion.pop(name, None)
+
+    def chat_engine(self, name: str) -> AsyncEngine | None:
+        return self._chat.get(name)
+
+    def completion_engine(self, name: str) -> AsyncEngine | None:
+        return self._completion.get(name)
+
+    def model_names(self) -> list[str]:
+        return sorted(set(self._chat) | set(self._completion))
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager | None = None,
+        metrics: ServiceMetrics | None = None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+    ):
+        self.manager = manager or ModelManager()
+        self.metrics = metrics or ServiceMetrics()
+        self.host = host
+        self.port = port
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat)
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/live", self._health)
+        self._runner: web.AppRunner | None = None
+
+    # --- lifecycle ----------------------------------------------------
+    async def start(self) -> int:
+        """Start serving; returns the bound port."""
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            sockets = getattr(s, "_server", None)
+            if sockets and sockets.sockets:
+                self.port = sockets.sockets[0].getsockname()[1]
+        logger.info("HTTP service listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # --- handlers -----------------------------------------------------
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy", "models": self.manager.model_names()}
+        )
+
+    async def _models(self, request: web.Request) -> web.Response:
+        listing = ModelList(
+            data=[ModelInfo(id=name) for name in self.manager.model_names()]
+        )
+        return web.json_response(listing.model_dump())
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.render(), content_type="text/plain", charset="utf-8"
+        )
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_llm(
+            request,
+            parse=ChatCompletionRequest.model_validate,
+            lookup=self.manager.chat_engine,
+            chunk_type=ChatCompletionChunk,
+            aggregate=aggregate_chat_stream,
+            endpoint="chat_completions",
+        )
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_llm(
+            request,
+            parse=_parse_completion_request,
+            lookup=self.manager.completion_engine,
+            chunk_type=CompletionChunk,
+            aggregate=aggregate_completion_stream,
+            endpoint="completions",
+            expand_batch=_expand_completion_batch,
+        )
+
+    async def _serve_llm(
+        self,
+        request: web.Request,
+        parse,
+        lookup,
+        chunk_type,
+        aggregate,
+        endpoint: str,
+        expand_batch=None,
+    ) -> web.StreamResponse:
+        try:
+            payload = await request.json()
+            req = parse(payload)
+        except Exception as e:
+            return _error_response(400, f"invalid request: {e}")
+        engine = lookup(req.model)
+        if engine is None:
+            return _error_response(
+                404, f"model {req.model!r} not found", err_type="model_not_found"
+            )
+        # OpenAI allows a list of prompts in one completion request; fan the
+        # batch out as independent sub-requests with re-indexed choices.
+        sub_payloads = expand_batch(payload) if expand_batch else [payload]
+        # One context per sub-request: a finished sub-stream must not stop
+        # its batch siblings; disconnect kills them all.
+        ctxs = [AsyncEngineContext() for _ in sub_payloads]
+        ctx = _FanoutContext(ctxs)
+        request_type = "stream" if req.stream else "unary"
+        streaming = req.stream
+        with self.metrics.track(req.model, endpoint, request_type) as tracker:
+            try:
+                streams = [
+                    await engine.generate(p, c) for p, c in zip(sub_payloads, ctxs)
+                ]
+            except PromptTooLongError as e:
+                tracker.status = "rejected"
+                return _error_response(400, str(e), err_type="context_length_exceeded")
+            except Exception as e:
+                logger.exception("engine rejected request")
+                tracker.status = "error"
+                return _error_response(500, str(e))
+
+            async def _typed_chunks():
+                for idx, stream in enumerate(streams):
+                    async for item in stream:
+                        if streaming:
+                            tracker.first_token()
+                        chunk = (
+                            chunk_type.model_validate(item)
+                            if isinstance(item, dict)
+                            else item
+                        )
+                        if idx and chunk.choices:
+                            for choice in chunk.choices:
+                                choice.index = idx
+                        yield chunk
+
+            if not req.stream:
+                try:
+                    full = await aggregate(_typed_chunks())
+                except Exception as e:
+                    logger.exception("request failed")
+                    tracker.status = "error"
+                    ctx.kill()
+                    return _error_response(500, str(e))
+                return web.json_response(full.model_dump(exclude_none=True))
+
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                }
+            )
+            await resp.prepare(request)
+            try:
+                async for chunk in _typed_chunks():
+                    frame = Annotated.from_data(chunk.model_dump(exclude_none=True))
+                    await resp.write(encode_frame(frame).encode())
+                await resp.write(encode_done().encode())
+            except (ConnectionResetError, asyncio.CancelledError):
+                # Client went away: kill generation immediately.
+                logger.info("client disconnected; killing request %s", ctx.id)
+                tracker.status = "disconnect"
+                ctx.kill()
+                raise
+            except Exception as e:
+                logger.exception("stream failed mid-flight")
+                tracker.status = "error"
+                ctx.kill()
+                err = Annotated.from_error(str(e))
+                await resp.write(encode_frame(err).encode())
+            await resp.write_eof()
+            return resp
+
+
+class _FanoutContext:
+    """Kill/stop fan-out over a batch's per-sub-request contexts."""
+
+    def __init__(self, ctxs: list[AsyncEngineContext]):
+        self._ctxs = ctxs
+        self.id = ctxs[0].id if ctxs else ""
+
+    def kill(self) -> None:
+        for c in self._ctxs:
+            c.kill()
+
+    def stop_generating(self) -> None:
+        for c in self._ctxs:
+            c.stop_generating()
+
+
+def _parse_completion_request(payload: dict) -> CompletionRequest:
+    return CompletionRequest.model_validate(payload)
+
+
+def _expand_completion_batch(payload: dict) -> list[dict]:
+    """Split a multi-prompt completion payload into per-prompt payloads."""
+    prompt = payload.get("prompt")
+    if isinstance(prompt, list) and prompt and not isinstance(prompt[0], int):
+        return [{**payload, "prompt": p} for p in prompt]
+    return [payload]
+
+
+def _error_response(
+    status: int, message: str, err_type: str = "invalid_request_error"
+) -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": status}},
+        status=status,
+    )
+
+
+def build_pipeline_engine(mdc, core_engine) -> AsyncEngine:
+    """preprocessor -> backend -> core engine, as one OpenAI-level engine."""
+    from ..backend import Backend
+    from ..preprocessor.preprocessor import OpenAIPreprocessor
+    from ..runtime.pipeline import build_pipeline
+
+    pre = OpenAIPreprocessor(mdc)
+    backend = Backend(core_engine, pre.tokenizer)
+    return build_pipeline([pre], backend)

@@ -1,0 +1,113 @@
+"""ModelDeploymentCard: everything a frontend needs to serve a model.
+
+Capability parity with ``/root/reference/lib/llm/src/model_card/``: a
+serializable card describing the model (context length, KV block size),
+its tokenizer, and its prompt template, published by workers and loaded
+by frontends so ingress never needs the weights.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+
+@dataclass
+class ModelDeploymentCard:
+    display_name: str
+    model_path: str = ""
+    context_length: int = 4096
+    kv_cache_block_size: int = 16
+    # Raw HF config.json contents (architecture, dims, eos ids, ...).
+    model_config: dict[str, Any] = field(default_factory=dict)
+    # Jinja chat template + special tokens from tokenizer_config.json.
+    chat_template: str | None = None
+    bos_token: str | None = None
+    eos_token: str | None = None
+    eos_token_ids: list[int] = field(default_factory=list)
+    # Where the frontend should load the tokenizer from.
+    tokenizer_path: str = ""
+    model_type: str = "chat"  # "chat" | "completion" | "backend"
+    migration_limit: int = 0
+
+    @property
+    def slug(self) -> str:
+        return self.display_name.replace("/", "--")
+
+    def mdcsum(self) -> str:
+        return hashlib.sha256(
+            json.dumps(asdict(self), sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, text: str) -> "ModelDeploymentCard":
+        return cls(**json.loads(text))
+
+    @classmethod
+    def from_local_path(
+        cls, path: str, display_name: str | None = None
+    ) -> "ModelDeploymentCard":
+        """Build a card from a HF-style model directory."""
+        name = display_name or os.path.basename(os.path.normpath(path))
+        card = cls(display_name=name, model_path=path, tokenizer_path=path)
+        cfg_path = os.path.join(path, "config.json")
+        if os.path.exists(cfg_path):
+            card.model_config = json.loads(open(cfg_path).read())
+            card.context_length = int(
+                card.model_config.get("max_position_embeddings", card.context_length)
+            )
+            eos = card.model_config.get("eos_token_id")
+            if eos is not None:
+                card.eos_token_ids = (
+                    [int(e) for e in eos] if isinstance(eos, list) else [int(eos)]
+                )
+        tok_cfg_path = os.path.join(path, "tokenizer_config.json")
+        if os.path.exists(tok_cfg_path):
+            tok_cfg = json.loads(open(tok_cfg_path).read())
+            card.chat_template = _select_chat_template(tok_cfg)
+            card.bos_token = _token_str(tok_cfg.get("bos_token"))
+            card.eos_token = _token_str(tok_cfg.get("eos_token"))
+        gen_cfg_path = os.path.join(path, "generation_config.json")
+        if os.path.exists(gen_cfg_path) and not card.eos_token_ids:
+            gen = json.loads(open(gen_cfg_path).read())
+            eos = gen.get("eos_token_id")
+            if eos is not None:
+                card.eos_token_ids = (
+                    [int(e) for e in eos] if isinstance(eos, list) else [int(eos)]
+                )
+        return card
+
+
+def _select_chat_template(tok_cfg: dict) -> str | None:
+    """tokenizer_config.json may hold one template or a named list
+    (``[{"name": "default", "template": ...}, {"name": "tool_use", ...}]``)."""
+    tpl = tok_cfg.get("chat_template")
+    if tpl is None:
+        return None
+    if isinstance(tpl, str):
+        return tpl
+    if isinstance(tpl, list):
+        by_name = {
+            t.get("name"): t.get("template")
+            for t in tpl
+            if isinstance(t, dict)
+        }
+        return by_name.get("default") or next(iter(by_name.values()), None)
+    return None
+
+
+def _token_str(value: Any) -> str | None:
+    """Token entries are either strings or AddedToken dicts."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        return value
+    if isinstance(value, dict):
+        return value.get("content")
+    return str(value)

@@ -1,0 +1,6 @@
+"""Request preprocessing: chat templating, tokenization, option extraction."""
+
+from .preprocessor import OpenAIPreprocessor
+from .prompt import PromptFormatError, PromptFormatter
+
+__all__ = ["OpenAIPreprocessor", "PromptFormatError", "PromptFormatter"]

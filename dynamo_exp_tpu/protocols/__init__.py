@@ -1,0 +1,52 @@
+"""Protocol types: engine-facing and OpenAI-compatible API surfaces."""
+
+from .aggregator import aggregate_chat_stream, aggregate_completion_stream
+from .common import (
+    BackendInput,
+    FinishReason,
+    LLMEngineOutput,
+    SamplingOptions,
+    StopConditions,
+)
+from .delta import ChatDeltaGenerator, CompletionDeltaGenerator
+from .openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatCompletionResponse,
+    ChatMessage,
+    CompletionChunk,
+    CompletionRequest,
+    CompletionResponse,
+    Extensions,
+    ModelInfo,
+    ModelList,
+    Usage,
+)
+from .sse import SseDecoder, decode_sse_stream, encode_done, encode_frame
+
+__all__ = [
+    "BackendInput",
+    "ChatCompletionChunk",
+    "ChatCompletionRequest",
+    "ChatCompletionResponse",
+    "ChatDeltaGenerator",
+    "ChatMessage",
+    "CompletionChunk",
+    "CompletionDeltaGenerator",
+    "CompletionRequest",
+    "CompletionResponse",
+    "Extensions",
+    "FinishReason",
+    "LLMEngineOutput",
+    "ModelInfo",
+    "ModelList",
+    "SamplingOptions",
+    "SseDecoder",
+    "StopConditions",
+    "Usage",
+    "aggregate_chat_stream",
+    "aggregate_completion_stream",
+    "decode_sse_stream",
+    "encode_done",
+    "encode_frame",
+]

@@ -1,0 +1,98 @@
+"""Engine-facing request/response types shared by all frontends.
+
+Capability parity with the reference's common protocol layer
+(``/root/reference/lib/llm/src/protocols/common.rs``): stop conditions,
+sampling options, the preprocessed ``BackendInput`` handed to engines, and
+the per-step ``LLMEngineOutput`` engines stream back.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any
+
+from pydantic import BaseModel, Field
+
+
+class FinishReason(str, enum.Enum):
+    EOS = "eos"
+    STOP = "stop"
+    LENGTH = "length"
+    CANCELLED = "cancelled"
+    ERROR = "error"
+
+    def to_openai(self) -> str:
+        return {
+            FinishReason.EOS: "stop",
+            FinishReason.STOP: "stop",
+            FinishReason.LENGTH: "length",
+            FinishReason.CANCELLED: "stop",
+            FinishReason.ERROR: "error",
+        }[self]
+
+
+class StopConditions(BaseModel):
+    """When to stop generating."""
+
+    max_tokens: int | None = None
+    stop: list[str] = Field(default_factory=list)  # hidden stop strings
+    stop_token_ids: list[int] = Field(default_factory=list)
+    min_tokens: int | None = None
+    ignore_eos: bool = False
+
+    def apply_defaults(self, max_tokens_default: int | None) -> None:
+        if self.max_tokens is None:
+            self.max_tokens = max_tokens_default
+
+
+class SamplingOptions(BaseModel):
+    """How to pick the next token."""
+
+    n: int = 1
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    frequency_penalty: float | None = None
+    presence_penalty: float | None = None
+    repetition_penalty: float | None = None
+    seed: int | None = None
+    logprobs: int | None = None
+
+
+class BackendInput(BaseModel):
+    """The fully preprocessed request handed to an execution engine:
+    token ids in, token ids out. This is the seam between the serving
+    stack and any engine implementation (TPU, echo, remote)."""
+
+    token_ids: list[int]
+    stop_conditions: StopConditions = Field(default_factory=StopConditions)
+    sampling_options: SamplingOptions = Field(default_factory=SamplingOptions)
+    annotations: list[str] = Field(default_factory=list)
+    # Router hint: estimated prefix-cache overlap blocks on the chosen worker.
+    estimated_prefix_hit_num_blocks: int | None = None
+    # Disaggregation: set when a remote prefill worker already computed the
+    # prompt's KV; the decode engine skips prefill for those blocks.
+    remote_prefill: dict[str, Any] | None = None
+
+    def to_dict(self) -> dict:
+        return self.model_dump(exclude_none=True)
+
+
+class LLMEngineOutput(BaseModel):
+    """One streamed step from an engine (token-level, pre-detokenization)."""
+
+    token_ids: list[int] = Field(default_factory=list)
+    # Engines that do their own detokenization may set text directly.
+    text: str | None = None
+    cum_log_probs: float | None = None
+    finish_reason: FinishReason | None = None
+    # Usage accounting, set on the final frame.
+    prompt_tokens: int | None = None
+    completion_tokens: int | None = None
+
+    def to_dict(self) -> dict:
+        return self.model_dump(exclude_none=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LLMEngineOutput":
+        return cls.model_validate(d)

@@ -1,0 +1,125 @@
+"""Delta generators: backend step outputs -> OpenAI stream chunks.
+
+Capability parity with ``/root/reference/lib/llm/src/protocols/openai/
+chat_completions/delta.rs`` and ``completions/delta.rs``.
+"""
+
+from __future__ import annotations
+
+from .common import FinishReason
+from .openai import (
+    ChatChoiceDelta,
+    ChatCompletionChunk,
+    ChatStreamChoice,
+    CompletionChoice,
+    CompletionChunk,
+    Usage,
+    new_request_id,
+    now_unix,
+)
+
+
+class ChatDeltaGenerator:
+    """Stateful converter for one chat request's response stream."""
+
+    def __init__(self, model: str, request_id: str | None = None, index: int = 0):
+        self.model = model
+        self.id = request_id or new_request_id("chatcmpl")
+        self.created = now_unix()
+        self.index = index
+        self._sent_role = False
+
+    def role_chunk(self) -> ChatCompletionChunk:
+        self._sent_role = True
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[
+                ChatStreamChoice(
+                    index=self.index, delta=ChatChoiceDelta(role="assistant")
+                )
+            ],
+        )
+
+    def text_chunk(self, text: str) -> ChatCompletionChunk:
+        delta = ChatChoiceDelta(content=text)
+        if not self._sent_role:
+            delta.role = "assistant"
+            self._sent_role = True
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[ChatStreamChoice(index=self.index, delta=delta)],
+        )
+
+    def finish_chunk(self, reason: FinishReason) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[
+                ChatStreamChoice(
+                    index=self.index,
+                    delta=ChatChoiceDelta(),
+                    finish_reason=reason.to_openai(),
+                )
+            ],
+        )
+
+    def usage_chunk(self, prompt_tokens: int, completion_tokens: int) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[],
+            usage=Usage(
+                prompt_tokens=prompt_tokens,
+                completion_tokens=completion_tokens,
+                total_tokens=prompt_tokens + completion_tokens,
+            ),
+        )
+
+
+class CompletionDeltaGenerator:
+    """Stateful converter for one text-completion request's stream."""
+
+    def __init__(self, model: str, request_id: str | None = None, index: int = 0):
+        self.model = model
+        self.id = request_id or new_request_id("cmpl")
+        self.created = now_unix()
+        self.index = index
+
+    def text_chunk(self, text: str) -> CompletionChunk:
+        return CompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[CompletionChoice(index=self.index, text=text)],
+        )
+
+    def finish_chunk(self, reason: FinishReason) -> CompletionChunk:
+        return CompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[
+                CompletionChoice(
+                    index=self.index, text="", finish_reason=reason.to_openai()
+                )
+            ],
+        )
+
+    def usage_chunk(self, prompt_tokens: int, completion_tokens: int) -> CompletionChunk:
+        return CompletionChunk(
+            id=self.id,
+            created=self.created,
+            model=self.model,
+            choices=[],
+            usage=Usage(
+                prompt_tokens=prompt_tokens,
+                completion_tokens=completion_tokens,
+                total_tokens=prompt_tokens + completion_tokens,
+            ),
+        )

@@ -1,0 +1,245 @@
+"""OpenAI-compatible API types: chat completions, completions, models.
+
+Capability parity with ``/root/reference/lib/llm/src/protocols/openai*``:
+request/response models for ``/v1/chat/completions`` and
+``/v1/completions`` (streaming and unary), plus the ``nvext``-style
+extension carrying annotations and ``ignore_eos``.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Literal
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from .common import SamplingOptions, StopConditions
+
+
+class Extensions(BaseModel):
+    """Framework extension field (the reference calls this ``nvext``)."""
+
+    ignore_eos: bool | None = None
+    annotations: list[str] = Field(default_factory=list)
+    greedy_sampling: bool | None = None
+
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    role: str
+    content: str | list[dict[str, Any]] | None = None
+    name: str | None = None
+    tool_calls: list[dict[str, Any]] | None = None
+    tool_call_id: str | None = None
+
+    def text_content(self) -> str:
+        if self.content is None:
+            return ""
+        if isinstance(self.content, str):
+            return self.content
+        return "".join(
+            part.get("text", "") for part in self.content if isinstance(part, dict)
+        )
+
+
+class StreamOptions(BaseModel):
+    include_usage: bool = False
+
+
+class ChatCompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    messages: list[ChatMessage]
+    stream: bool = False
+    stream_options: StreamOptions | None = None
+    max_tokens: int | None = None
+    max_completion_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    n: int = 1
+    stop: str | list[str] | None = None
+    frequency_penalty: float | None = None
+    presence_penalty: float | None = None
+    repetition_penalty: float | None = None
+    seed: int | None = None
+    logprobs: bool | None = None
+    top_logprobs: int | None = None
+    user: str | None = None
+    tools: list[dict[str, Any]] | None = None
+    tool_choice: Any | None = None
+    min_tokens: int | None = None
+    ignore_eos: bool | None = None
+    nvext: Extensions | None = None
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def extract_stop_conditions(self) -> StopConditions:
+        return StopConditions(
+            max_tokens=self.max_tokens or self.max_completion_tokens,
+            stop=self.stop_list(),
+            min_tokens=self.min_tokens,
+            ignore_eos=bool(
+                self.ignore_eos or (self.nvext and self.nvext.ignore_eos)
+            ),
+        )
+
+    def extract_sampling_options(self) -> SamplingOptions:
+        return SamplingOptions(
+            n=self.n,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            repetition_penalty=self.repetition_penalty,
+            seed=self.seed,
+            logprobs=self.top_logprobs if self.logprobs else None,
+        )
+
+    def annotations(self) -> list[str]:
+        return list(self.nvext.annotations) if self.nvext else []
+
+
+class CompletionRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    prompt: str | list[str] | list[int] | list[list[int]]
+    stream: bool = False
+    stream_options: StreamOptions | None = None
+    max_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    n: int = 1
+    stop: str | list[str] | None = None
+    frequency_penalty: float | None = None
+    presence_penalty: float | None = None
+    seed: int | None = None
+    logprobs: int | None = None
+    echo: bool = False
+    user: str | None = None
+    min_tokens: int | None = None
+    ignore_eos: bool | None = None
+    nvext: Extensions | None = None
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def extract_stop_conditions(self) -> StopConditions:
+        return StopConditions(
+            max_tokens=self.max_tokens,
+            stop=self.stop_list(),
+            min_tokens=self.min_tokens,
+            ignore_eos=bool(
+                self.ignore_eos or (self.nvext and self.nvext.ignore_eos)
+            ),
+        )
+
+    def extract_sampling_options(self) -> SamplingOptions:
+        return SamplingOptions(
+            n=self.n,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            top_k=self.top_k,
+            frequency_penalty=self.frequency_penalty,
+            presence_penalty=self.presence_penalty,
+            seed=self.seed,
+            logprobs=self.logprobs,
+        )
+
+    def annotations(self) -> list[str]:
+        return list(self.nvext.annotations) if self.nvext else []
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatChoiceDelta(BaseModel):
+    role: str | None = None
+    content: str | None = None
+    tool_calls: list[dict[str, Any]] | None = None
+
+
+class ChatStreamChoice(BaseModel):
+    index: int = 0
+    delta: ChatChoiceDelta
+    finish_reason: str | None = None
+    logprobs: Any | None = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int
+    model: str
+    choices: list[ChatStreamChoice]
+    usage: Usage | None = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage
+    finish_reason: str | None = None
+    logprobs: Any | None = None
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int
+    model: str
+    choices: list[ChatChoice]
+    usage: Usage | None = None
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: str | None = None
+    logprobs: Any | None = None
+
+
+class CompletionChunk(BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int
+    model: str
+    choices: list[CompletionChoice]
+    usage: Usage | None = None
+
+
+class CompletionResponse(CompletionChunk):
+    pass
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = 0
+    owned_by: str = "organization"
+
+
+class ModelList(BaseModel):
+    object: Literal["list"] = "list"
+    data: list[ModelInfo] = Field(default_factory=list)
+
+
+def new_request_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def now_unix() -> int:
+    return int(time.time())

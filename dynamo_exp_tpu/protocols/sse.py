@@ -1,0 +1,98 @@
+"""Server-Sent Events codec for OpenAI-style streaming responses.
+
+Capability parity with ``/root/reference/lib/llm/src/protocols/codec.rs``:
+encode Annotated frames as SSE ``data:``/``event:``/comment lines and
+decode them back (used by clients and tests).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, AsyncIterator, Iterator
+
+from ..runtime.annotated import Annotated
+
+DONE_SENTINEL = "[DONE]"
+
+
+def encode_frame(ann: Annotated[Any]) -> str:
+    """Encode one Annotated frame as an SSE message."""
+    lines: list[str] = []
+    for c in ann.comment:
+        lines.append(f": {c}")
+    if ann.event is not None:
+        lines.append(f"event: {ann.event}")
+    if ann.id is not None:
+        lines.append(f"id: {ann.id}")
+    if ann.data is not None:
+        data = ann.data if isinstance(ann.data, str) else json.dumps(ann.data)
+        for line in data.split("\n"):
+            lines.append(f"data: {line}")
+    return "\n".join(lines) + "\n\n"
+
+
+def encode_done() -> str:
+    return f"data: {DONE_SENTINEL}\n\n"
+
+
+class SseDecoder:
+    """Incremental SSE parser: feed text chunks, yields Annotated frames."""
+
+    def __init__(self):
+        self._buf = ""
+
+    def feed(self, chunk: str) -> Iterator[Annotated[Any]]:
+        self._buf += chunk
+        while "\n\n" in self._buf:
+            raw, self._buf = self._buf.split("\n\n", 1)
+            frame = self._parse(raw)
+            if frame is not None:
+                yield frame
+
+    def _parse(self, raw: str) -> Annotated[Any] | None:
+        data_lines: list[str] = []
+        event = None
+        frame_id = None
+        comments: list[str] = []
+        for line in raw.split("\n"):
+            if not line:
+                continue
+            if line.startswith(":"):
+                comments.append(line[1:].strip())
+            elif line.startswith("event:"):
+                event = line[len("event:") :].strip()
+            elif line.startswith("id:"):
+                frame_id = line[len("id:") :].strip()
+            elif line.startswith("data:"):
+                # SSE spec: strip at most ONE leading space; further
+                # whitespace is payload (matters for string frames).
+                value = line[len("data:") :]
+                if value.startswith(" "):
+                    value = value[1:]
+                data_lines.append(value)
+        if not data_lines and event is None and not comments:
+            return None
+        data: Any = None
+        if data_lines:
+            joined = "\n".join(data_lines)
+            if joined == DONE_SENTINEL:
+                data = DONE_SENTINEL
+            else:
+                try:
+                    data = json.loads(joined)
+                except json.JSONDecodeError:
+                    data = joined
+        return Annotated(data=data, event=event, id=frame_id, comment=comments)
+
+
+async def decode_sse_stream(
+    chunks: AsyncIterator[bytes],
+) -> AsyncIterator[Annotated[Any]]:
+    """Decode an async byte stream of SSE into Annotated frames, stopping
+    at the [DONE] sentinel."""
+    decoder = SseDecoder()
+    async for chunk in chunks:
+        for frame in decoder.feed(chunk.decode("utf-8", errors="replace")):
+            if frame.data == DONE_SENTINEL:
+                return
+            yield frame

@@ -220,11 +220,21 @@ class ServedInstance:
     def instance_id(self) -> int:
         return self.info.instance_id
 
-    async def close(self, revoke_lease: bool = True) -> None:
-        """Stop serving: revoke lease first (drop from discovery), then
-        drain inflight requests — the reference's graceful-shutdown order."""
+    async def close(self, revoke_lease: bool | None = None) -> None:
+        """Stop serving: drop from discovery first, then drain inflight
+        requests — the reference's graceful-shutdown order.
+
+        By default the lease is revoked only if it is dedicated to this
+        instance; a process-shared primary lease (which other endpoints
+        ride on) is left alone and just this instance is deregistered.
+        """
+        drt = self.endpoint.drt
+        if revoke_lease is None:
+            revoke_lease = self.lease is not drt._primary_lease
         if revoke_lease and self.lease.is_valid():
             await self.lease.revoke()
+        else:
+            await drt.discovery.deregister_instance(self.info.instance_id)
         await self._served.close()
 
 

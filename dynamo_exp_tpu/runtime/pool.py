@@ -17,7 +17,8 @@ T = TypeVar("T")
 
 
 class PoolItem(Generic[T]):
-    """A checked-out pool item; ``release()`` (or ``with``) returns it."""
+    """A checked-out pool item; ``release()`` (or ``with``, or garbage
+    collection of a dropped item) returns it to the pool."""
 
     def __init__(self, value: T, pool: "Pool[T]"):
         self._value = value
@@ -38,6 +39,11 @@ class PoolItem(Generic[T]):
         return self.value
 
     def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __del__(self) -> None:
+        # RAII backstop: a dropped item (e.g. on an exception path) must
+        # not permanently shrink pool capacity.
         self.release()
 
 
@@ -74,8 +80,9 @@ class Pool(Generic[T]):
         except asyncio.CancelledError:
             # If the value was already handed to us, re-offer it so the
             # item isn't leaked (asyncio.Queue-style cancellation safety).
+            # on_return already ran for this value; don't run it again.
             if fut.done() and not fut.cancelled():
-                self._return(fut.result())
+                self._offer(fut.result())
             else:
                 with contextlib.suppress(ValueError):
                     self._waiters.remove(fut)
@@ -85,6 +92,9 @@ class Pool(Generic[T]):
     def _return(self, value: T) -> None:
         if self._on_return is not None:
             self._on_return(value)
+        self._offer(value)
+
+    def _offer(self, value: T) -> None:
         while self._waiters:
             fut = self._waiters.popleft()
             if not fut.done():

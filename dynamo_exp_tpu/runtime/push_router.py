@@ -45,16 +45,19 @@ class PushRouter(AsyncEngine[dict, Any]):
             raise NoInstancesError("no live instances for endpoint")
         # An explicit target always wins, regardless of mode.
         if "_worker_instance_id" in request:
-            return self.client.instance(int(request["_worker_instance_id"]))
+            try:
+                return self.client.instance(int(request["_worker_instance_id"]))
+            except KeyError as e:
+                # Stale target (lease expired) is a routing error, so callers
+                # can retry/503 with one except clause.
+                raise NoInstancesError(str(e)) from e
         if self.mode is RouterMode.RANDOM:
             return random.choice(instances)
         if self.mode is RouterMode.ROUND_ROBIN:
             return instances[next(self._rr) % len(instances)]
         if self.mode in (RouterMode.DIRECT, RouterMode.KV):
-            worker_id = request.get("_worker_instance_id")
-            if worker_id is None:
-                raise ValueError("direct routing requires _worker_instance_id")
-            return self.client.instance(int(worker_id))
+            # The explicit-target branch above handles present ids.
+            raise ValueError("direct routing requires _worker_instance_id")
         # STATIC: single fixed instance
         return instances[0]
 

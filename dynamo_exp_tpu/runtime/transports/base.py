@@ -106,6 +106,10 @@ class Discovery(abc.ABC):
     async def create_lease(self, ttl_s: float | None = None) -> Lease: ...
 
     @abc.abstractmethod
+    async def deregister_instance(self, instance_id: int) -> None:
+        """Remove one instance without touching its lease."""
+
+    @abc.abstractmethod
     async def list_instances(self, prefix: str) -> list[InstanceInfo]: ...
 
     @abc.abstractmethod

@@ -100,6 +100,12 @@ class InProcDiscovery(Discovery):
         await self._bump()
         return lease
 
+    async def deregister_instance(self, instance_id: int) -> None:
+        self._instances.pop(instance_id, None)
+        for insts in self._lease_instances.values():
+            insts.discard(instance_id)
+        await self._bump()
+
     async def _revoke_lease(self, lease_id: int) -> None:
         for inst in self._lease_instances.pop(lease_id, set()):
             self._instances.pop(inst, None)

@@ -1,0 +1,139 @@
+"""Unified tokenizer interface with incremental streaming detokenization.
+
+Capability parity with ``/root/reference/lib/llm/src/tokenizers.rs``: a
+``Tokenizer`` facade over HuggingFace ``tokenizers`` (with a
+transformers fallback), ``Encoding`` results, and a ``DecodeStream`` that
+turns a token-id stream into a text stream without emitting partial
+UTF-8/byte-level artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Sequence
+
+REPLACEMENT_CHAR = "�"
+
+
+@dataclass
+class Encoding:
+    ids: list[int]
+    tokens: list[str]
+
+
+class Tokenizer:
+    """Facade over a HF ``tokenizers.Tokenizer`` (preferred) or a
+    ``transformers`` tokenizer object."""
+
+    def __init__(self, backend, eos_token_ids: list[int] | None = None):
+        self._t = backend
+        self._is_hf_tokenizers = hasattr(backend, "encode_batch")
+        self.eos_token_ids = eos_token_ids or []
+
+    # --- construction -------------------------------------------------
+    @classmethod
+    def from_pretrained(cls, path: str) -> "Tokenizer":
+        """Load from a model directory / file / HF hub id."""
+        eos_ids: list[int] = []
+        if os.path.isdir(path):
+            tok_json = os.path.join(path, "tokenizer.json")
+            if os.path.exists(tok_json):
+                import tokenizers
+
+                backend = tokenizers.Tokenizer.from_file(tok_json)
+                eos_ids = _eos_ids_from_config(path, backend)
+                return cls(backend, eos_ids)
+        elif path.endswith(".json") and os.path.exists(path):
+            import tokenizers
+
+            backend = tokenizers.Tokenizer.from_file(path)
+            eos_ids = _eos_ids_from_config(os.path.dirname(path), backend)
+            return cls(backend, eos_ids)
+        from transformers import AutoTokenizer
+
+        t = AutoTokenizer.from_pretrained(path)
+        if t.eos_token_id is not None:
+            eos_ids = [t.eos_token_id]
+        return cls(t, eos_ids)
+
+    # --- encode/decode ------------------------------------------------
+    def encode(self, text: str, add_special_tokens: bool = True) -> Encoding:
+        if self._is_hf_tokenizers:
+            enc = self._t.encode(text, add_special_tokens=add_special_tokens)
+            return Encoding(ids=list(enc.ids), tokens=list(enc.tokens))
+        ids = self._t.encode(text, add_special_tokens=add_special_tokens)
+        return Encoding(ids=list(ids), tokens=[])
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        if self._is_hf_tokenizers:
+            return self._t.decode(list(ids), skip_special_tokens=skip_special_tokens)
+        return self._t.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    @property
+    def vocab_size(self) -> int:
+        if self._is_hf_tokenizers:
+            return self._t.get_vocab_size()
+        return len(self._t)
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens)
+
+
+class DecodeStream:
+    """Incremental detokenizer.
+
+    Decoding token-by-token is wrong for BPE/byte-level vocabularies: a
+    token may be half of a multi-byte character, and some tokenizers add
+    leading-space marks only in context. The standard fix (used across
+    serving stacks): keep a window of ids, decode ``prefix..read`` and
+    ``prefix..end``, and emit only the well-formed difference.
+    """
+
+    def __init__(self, tokenizer: Tokenizer, skip_special_tokens: bool = True):
+        self._tok = tokenizer
+        self._skip = skip_special_tokens
+        self._ids: list[int] = []
+        self._prefix_offset = 0
+        self._read_offset = 0
+
+    def step(self, token_id: int) -> str | None:
+        """Feed one token id; returns newly-finalized text, or None."""
+        self._ids.append(int(token_id))
+        prefix_text = self._tok.decode(
+            self._ids[self._prefix_offset : self._read_offset], self._skip
+        )
+        new_text = self._tok.decode(self._ids[self._prefix_offset :], self._skip)
+        if new_text.endswith(REPLACEMENT_CHAR):
+            # Partial multi-byte character: hold until complete.
+            return None
+        if len(new_text) <= len(prefix_text):
+            return None
+        text = new_text[len(prefix_text) :]
+        self._prefix_offset = self._read_offset
+        self._read_offset = len(self._ids)
+        return text
+
+
+def _eos_ids_from_config(model_dir: str, backend) -> list[int]:
+    """Pull EOS token id(s) from config.json / generation_config.json."""
+    import json
+
+    for fname in ("generation_config.json", "config.json"):
+        p = os.path.join(model_dir, fname)
+        if not os.path.exists(p):
+            continue
+        try:
+            cfg = json.loads(open(p).read())
+        except (OSError, json.JSONDecodeError):
+            continue
+        eos = cfg.get("eos_token_id")
+        if eos is None:
+            continue
+        return [int(e) for e in eos] if isinstance(eos, list) else [int(eos)]
+    # Fall back to the literal </s>-style token if the vocab has one.
+    for candidate in ("</s>", "<|endoftext|>", "<|eot_id|>"):
+        tid = backend.token_to_id(candidate)
+        if tid is not None:
+            return [tid]
+    return []

@@ -179,12 +179,21 @@ class TokenBlockSequence:
         """
         if num_tokens > self._count:
             raise ValueError(f"cannot truncate {self._count} tokens to {num_tokens}")
-        tokens = self.all_tokens()[:num_tokens]
-        self._blocks = []
-        self._partial = PartialTokenBlock(block_size=self.block_size, seed=self.seed)
-        self._count = 0
+        # Surviving complete blocks are unchanged by construction; only the
+        # partial tail needs rebuilding (no re-hashing of the kept prefix).
+        keep_blocks = num_tokens // self.block_size
+        tail = self.all_tokens()[keep_blocks * self.block_size : num_tokens]
+        self._blocks = self._blocks[:keep_blocks]
+        self._partial = PartialTokenBlock(
+            block_size=self.block_size,
+            seed=self.seed,
+            parent_sequence_hash=(
+                self._blocks[-1].sequence_hash if self._blocks else None
+            ),
+        )
+        self._count = keep_blocks * self.block_size
         on_block, self._on_block = self._on_block, None
         try:
-            self.extend(tokens)
+            self.extend(tail)
         finally:
             self._on_block = on_block

@@ -1,0 +1,10 @@
+"""Shared fixtures for the test suite."""
+
+import pytest
+
+from .fixtures import build_tiny_model_dir
+
+
+@pytest.fixture(scope="session")
+def tiny_model_dir(tmp_path_factory) -> str:
+    return build_tiny_model_dir(str(tmp_path_factory.mktemp("tiny-model")))

@@ -1,0 +1,88 @@
+"""Shared test fixtures: build a tiny self-contained model directory
+(byte-level BPE tokenizer + llama-style config + chat template) offline."""
+
+from __future__ import annotations
+
+import json
+import os
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message.role }}|>{{ message.content }}</s>"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+TRAIN_TEXT = [
+    "hello world, this is a test of the emergency tokenizer system.",
+    "the quick brown fox jumps over the lazy dog. 0123456789",
+    "café naïve 日本語 emoji ☃ snowman",
+    "STOP stop Stop sequences are hidden from the client output.",
+    "<|user|><|assistant|><|system|></s><s>",
+]
+
+
+def build_tiny_model_dir(
+    path: str,
+    vocab_size: int = 384,
+    hidden_size: int = 64,
+    num_layers: int = 2,
+    num_heads: int = 4,
+    num_kv_heads: int = 2,
+    intermediate_size: int = 128,
+    max_position_embeddings: int = 512,
+) -> str:
+    """Create a HF-style model dir with tokenizer + config, no weights."""
+    os.makedirs(path, exist_ok=True)
+    tok_json = os.path.join(path, "tokenizer.json")
+    if not os.path.exists(tok_json):
+        from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+        tok = Tokenizer(models.BPE(unk_token=None))
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tok.decoder = decoders.ByteLevel()
+        trainer = trainers.BpeTrainer(
+            vocab_size=vocab_size,
+            special_tokens=["<s>", "</s>", "<|user|>", "<|assistant|>", "<|system|>"],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        )
+        tok.train_from_iterator(TRAIN_TEXT, trainer)
+        tok.save(tok_json)
+    real_vocab = _vocab_size(tok_json)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(
+            {
+                "architectures": ["LlamaForCausalLM"],
+                "model_type": "llama",
+                "vocab_size": real_vocab,
+                "hidden_size": hidden_size,
+                "num_hidden_layers": num_layers,
+                "num_attention_heads": num_heads,
+                "num_key_value_heads": num_kv_heads,
+                "intermediate_size": intermediate_size,
+                "max_position_embeddings": max_position_embeddings,
+                "rms_norm_eps": 1e-5,
+                "rope_theta": 10000.0,
+                "bos_token_id": 0,
+                "eos_token_id": 1,
+                "tie_word_embeddings": False,
+                "torch_dtype": "bfloat16",
+            },
+            f,
+        )
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as f:
+        json.dump(
+            {
+                "bos_token": "<s>",
+                "eos_token": "</s>",
+                "chat_template": CHAT_TEMPLATE,
+            },
+            f,
+        )
+    return path
+
+
+def _vocab_size(tok_json: str) -> int:
+    from tokenizers import Tokenizer
+
+    return Tokenizer.from_file(tok_json).get_vocab_size()

@@ -1,0 +1,203 @@
+"""Tests for tokenizer streaming decode, chat templating, preprocessor,
+and the detokenizing backend with stop handling."""
+
+import pytest
+
+from dynamo_exp_tpu.backend import Backend, StopSequenceJail
+from dynamo_exp_tpu.engines.echo import EchoEngineCore
+from dynamo_exp_tpu.model_card import ModelDeploymentCard
+from dynamo_exp_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter
+from dynamo_exp_tpu.protocols import (
+    BackendInput,
+    ChatCompletionRequest,
+    FinishReason,
+    LLMEngineOutput,
+    StopConditions,
+)
+from dynamo_exp_tpu.tokenizer import Tokenizer
+
+
+# --- tokenizer ---------------------------------------------------------
+def test_decode_stream_reassembles_text(tiny_model_dir):
+    tok = Tokenizer.from_pretrained(tiny_model_dir)
+    text = "hello world café 日本語 snowman"
+    ids = tok.encode(text, add_special_tokens=False).ids
+    stream = tok.decode_stream()
+    out = "".join(p for p in (stream.step(t) for t in ids) if p)
+    assert out == text
+
+
+def test_eos_ids_loaded_from_config(tiny_model_dir):
+    tok = Tokenizer.from_pretrained(tiny_model_dir)
+    assert tok.eos_token_ids == [1]
+
+
+# --- chat template -----------------------------------------------------
+def test_prompt_formatter_renders_template(tiny_model_dir):
+    mdc = ModelDeploymentCard.from_local_path(tiny_model_dir)
+    fmt = PromptFormatter(mdc)
+    out = fmt.render(
+        [
+            {"role": "system", "content": "be nice"},
+            {"role": "user", "content": "hi"},
+        ]
+    )
+    assert out == "<|system|>be nice</s><|user|>hi</s><|assistant|>"
+
+
+def test_prompt_formatter_fallback_without_template():
+    mdc = ModelDeploymentCard(display_name="x")
+    out = PromptFormatter(mdc).render([{"role": "user", "content": "hi"}])
+    assert "user: hi" in out and out.endswith("assistant:")
+
+
+# --- stop jail ---------------------------------------------------------
+def test_stop_jail_hides_full_stop_sequence():
+    jail = StopSequenceJail(["STOP"])
+    safe, matched = jail.feed("hello ST")
+    assert safe == "hello " and not matched
+    safe, matched = jail.feed("OP world")
+    assert safe == "" and matched
+
+
+def test_stop_jail_releases_diverging_prefix():
+    jail = StopSequenceJail(["STOP"])
+    safe, matched = jail.feed("a ST")
+    assert safe == "a " and not matched
+    safe, matched = jail.feed("ART")  # "STA"... diverges from "STOP" at 'A'
+    assert safe == "START"[:-1] + "T" or safe == "START"  # released in full
+    assert not matched
+    assert jail.flush() == ""
+
+
+def test_stop_jail_flush_releases_tail():
+    jail = StopSequenceJail(["STOP"])
+    safe, _ = jail.feed("end with S")
+    assert safe == "end with "
+    assert jail.flush() == "S"
+
+
+# --- preprocessor ------------------------------------------------------
+def test_preprocess_chat_builds_backend_input(tiny_model_dir):
+    mdc = ModelDeploymentCard.from_local_path(tiny_model_dir)
+    pre = OpenAIPreprocessor(mdc)
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "hello world"}],
+            "max_tokens": 5,
+            "stop": ["END"],
+        }
+    )
+    binput = pre.preprocess_chat(req)
+    assert len(binput.token_ids) > 0
+    assert binput.stop_conditions.max_tokens == 5
+    assert binput.stop_conditions.stop == ["END"]
+    # EOS ids filled from the model card.
+    assert binput.stop_conditions.stop_token_ids == [1]
+    # Round-trips through the tokenizer to the rendered prompt.
+    assert "hello world" in pre.tokenizer.decode(binput.token_ids)
+
+
+def test_preprocess_default_max_tokens_fills_context(tiny_model_dir):
+    mdc = ModelDeploymentCard.from_local_path(tiny_model_dir)
+    pre = OpenAIPreprocessor(mdc)
+    req = ChatCompletionRequest.model_validate(
+        {"model": "tiny", "messages": [{"role": "user", "content": "hi"}]}
+    )
+    binput = pre.preprocess_chat(req)
+    assert (
+        binput.stop_conditions.max_tokens
+        == mdc.context_length - len(binput.token_ids)
+    )
+
+
+# --- backend -----------------------------------------------------------
+@pytest.mark.asyncio
+async def test_backend_detokenizes_echo_stream(tiny_model_dir):
+    tok = Tokenizer.from_pretrained(tiny_model_dir)
+    backend = Backend(EchoEngineCore(), tok)
+    text = "the quick brown fox"
+    ids = tok.encode(text, add_special_tokens=False).ids
+    binput = BackendInput(
+        token_ids=ids, stop_conditions=StopConditions(max_tokens=100)
+    )
+    stream = await backend.generate(binput)
+    pieces, finish = [], None
+    async for item in stream:
+        out = LLMEngineOutput.from_dict(item)
+        if out.text:
+            pieces.append(out.text)
+        if out.finish_reason:
+            finish = out.finish_reason
+    assert "".join(pieces) == text
+    assert finish == FinishReason.LENGTH
+
+
+@pytest.mark.asyncio
+async def test_backend_stops_on_eos_token(tiny_model_dir):
+    tok = Tokenizer.from_pretrained(tiny_model_dir)
+    backend = Backend(EchoEngineCore(), tok)
+    ids = tok.encode("hello", add_special_tokens=False).ids
+    # Inject EOS (id 1) mid-stream.
+    binput = BackendInput(
+        token_ids=[ids[0], 1] + ids[1:],
+        stop_conditions=StopConditions(max_tokens=100, stop_token_ids=[1]),
+    )
+    stream = await backend.generate(binput)
+    outs = [LLMEngineOutput.from_dict(i) async for i in stream]
+    assert outs[-1].finish_reason == FinishReason.EOS
+    # Nothing after EOS was emitted.
+    text = "".join(o.text or "" for o in outs)
+    assert "hello"[1:] not in text or text == ""
+
+
+@pytest.mark.asyncio
+async def test_backend_hidden_stop_string(tiny_model_dir):
+    tok = Tokenizer.from_pretrained(tiny_model_dir)
+    backend = Backend(EchoEngineCore(), tok)
+    ids = tok.encode("hello STOP world", add_special_tokens=False).ids
+    binput = BackendInput(
+        token_ids=ids,
+        stop_conditions=StopConditions(max_tokens=100, stop=["STOP"]),
+    )
+    stream = await backend.generate(binput)
+    outs = [LLMEngineOutput.from_dict(i) async for i in stream]
+    text = "".join(o.text or "" for o in outs)
+    assert "STOP" not in text
+    assert "world" not in text
+    assert text.startswith("hello")
+    assert outs[-1].finish_reason == FinishReason.STOP
+
+
+@pytest.mark.asyncio
+async def test_backend_max_tokens(tiny_model_dir):
+    tok = Tokenizer.from_pretrained(tiny_model_dir)
+    backend = Backend(EchoEngineCore(), tok)
+    ids = tok.encode("the quick brown fox jumps", add_special_tokens=False).ids
+    binput = BackendInput(
+        token_ids=ids, stop_conditions=StopConditions(max_tokens=2)
+    )
+    stream = await backend.generate(binput)
+    outs = [LLMEngineOutput.from_dict(i) async for i in stream]
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+    assert outs[-1].completion_tokens == 2
+
+
+@pytest.mark.asyncio
+async def test_backend_flushes_jailed_text_on_length_finish(tiny_model_dir):
+    """Regression: text held as a possible stop-prefix must be released
+    when generation ends without the stop string completing."""
+    tok = Tokenizer.from_pretrained(tiny_model_dir)
+    backend = Backend(EchoEngineCore(), tok)
+    text = "end with S"
+    ids = tok.encode(text, add_special_tokens=False).ids
+    binput = BackendInput(
+        token_ids=ids,
+        stop_conditions=StopConditions(max_tokens=len(ids), stop=["STOP"]),
+    )
+    stream = await backend.generate(binput)
+    pieces = []
+    async for i in stream:
+        pieces.append(LLMEngineOutput.from_dict(i).text or "")
+    assert "".join(pieces) == text  # trailing "S" not swallowed

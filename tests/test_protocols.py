@@ -1,0 +1,105 @@
+"""Tests for OpenAI protocol types, SSE codec, delta generation, aggregation."""
+
+import pytest
+
+from dynamo_exp_tpu.protocols import (
+    ChatCompletionRequest,
+    ChatDeltaGenerator,
+    CompletionRequest,
+    FinishReason,
+    SseDecoder,
+    aggregate_chat_stream,
+    encode_done,
+    encode_frame,
+)
+from dynamo_exp_tpu.runtime.annotated import Annotated
+
+
+def test_chat_request_stop_and_sampling_extraction():
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 7,
+            "stop": "END",
+            "temperature": 0.5,
+            "top_p": 0.9,
+            "nvext": {"ignore_eos": True, "annotations": ["ttft"]},
+        }
+    )
+    stop = req.extract_stop_conditions()
+    assert stop.max_tokens == 7
+    assert stop.stop == ["END"]
+    assert stop.ignore_eos is True
+    sampling = req.extract_sampling_options()
+    assert sampling.temperature == 0.5 and sampling.top_p == 0.9
+    assert req.annotations() == ["ttft"]
+
+
+def test_completion_request_token_prompt():
+    req = CompletionRequest.model_validate({"model": "m", "prompt": [1, 2, 3]})
+    assert req.prompt == [1, 2, 3]
+
+
+def test_multimodal_content_parts_text():
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": "look at "},
+                        {"type": "text", "text": "this"},
+                    ],
+                }
+            ],
+        }
+    )
+    assert req.messages[0].text_content() == "look at this"
+
+
+def test_sse_roundtrip():
+    frames = [
+        Annotated.from_data({"x": 1}),
+        Annotated.from_error("bad thing"),
+        Annotated(data={"y": 2}, event="annotation", comment=["note"]),
+    ]
+    wire = "".join(encode_frame(f) for f in frames) + encode_done()
+    decoder = SseDecoder()
+    out = list(decoder.feed(wire))
+    assert out[0].data == {"x": 1}
+    assert out[1].is_error() and out[1].error_message() == "bad thing"
+    assert out[2].event == "annotation" and out[2].comment == ["note"]
+    assert out[3].data == "[DONE]"
+
+
+def test_sse_incremental_chunks():
+    frame = encode_frame(Annotated.from_data({"long": "x" * 100}))
+    decoder = SseDecoder()
+    out = []
+    for i in range(0, len(frame), 7):
+        out.extend(decoder.feed(frame[i : i + 7]))
+    assert len(out) == 1 and out[0].data == {"long": "x" * 100}
+
+
+@pytest.mark.asyncio
+async def test_delta_and_aggregation_roundtrip():
+    gen = ChatDeltaGenerator("model-x")
+    chunks = [
+        gen.text_chunk("Hello "),
+        gen.text_chunk("world"),
+        gen.finish_chunk(FinishReason.EOS),
+        gen.usage_chunk(10, 2),
+    ]
+
+    async def _stream():
+        for c in chunks:
+            yield c
+
+    full = await aggregate_chat_stream(_stream())
+    assert full.choices[0].message.content == "Hello world"
+    assert full.choices[0].message.role == "assistant"
+    assert full.choices[0].finish_reason == "stop"
+    assert full.usage.total_tokens == 12
+    assert full.id == gen.id
