@@ -1,0 +1,22 @@
+from .config import LLAMA_1B, LLAMA_3B, LLAMA_8B, PRESETS, TINY, ModelConfig
+from .llama import (
+    forward,
+    init_kv_cache,
+    init_params,
+    kv_cache_shardings,
+    param_shardings,
+)
+
+__all__ = [
+    "ModelConfig",
+    "TINY",
+    "LLAMA_1B",
+    "LLAMA_3B",
+    "LLAMA_8B",
+    "PRESETS",
+    "forward",
+    "init_params",
+    "init_kv_cache",
+    "param_shardings",
+    "kv_cache_shardings",
+]

@@ -1,0 +1,126 @@
+"""Model configuration for the TPU engine's Llama-family transformers.
+
+The reference delegates model definition to wrapped engines (vLLM/sglang/
+mistralrs — e.g. ``/root/reference/lib/engines/mistralrs/src/lib.rs:72-164``
+loads HF configs). Here the engine is in-process JAX, so the config is
+first-class: parsed from HF ``config.json`` and carried by the
+ModelDeploymentCard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama-family architecture hyperparameters (covers Llama 2/3,
+    DeepSeek-R1-Distill-Llama, TinyLlama, Qwen2-without-bias subset)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int | None = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    # hash=False: HF rope_scaling is a dict (unhashable); excluded from the
+    # dataclass hash so ModelConfig stays usable as a jit static argument.
+    rope_scaling: dict | None = field(default=None, hash=False)
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    dtype: str = "bfloat16"
+    model_type: str = "llama"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @classmethod
+    def from_hf_config(cls, cfg: dict) -> "ModelConfig":
+        """Build from a HuggingFace ``config.json`` dict."""
+        return cls(
+            vocab_size=cfg.get("vocab_size", 32000),
+            hidden_size=cfg.get("hidden_size", 4096),
+            intermediate_size=cfg.get("intermediate_size", 11008),
+            num_layers=cfg.get("num_hidden_layers", 32),
+            num_heads=cfg.get("num_attention_heads", 32),
+            num_kv_heads=cfg.get(
+                "num_key_value_heads", cfg.get("num_attention_heads", 32)
+            ),
+            head_dim=cfg.get("head_dim"),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=cfg.get("rope_scaling"),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            attention_bias=cfg.get("attention_bias", False),
+            dtype=cfg.get("torch_dtype", "bfloat16"),
+            model_type=cfg.get("model_type", "llama"),
+        )
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f))
+
+
+# Presets used by tests, the dry-run entrypoints, and the benchmark.
+TINY = ModelConfig(
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    max_position_embeddings=512,
+    rms_norm_eps=1e-5,
+)
+
+LLAMA_1B = ModelConfig(  # Llama-3.2-1B shape
+    vocab_size=128256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    rope_theta=500000.0,
+    max_position_embeddings=8192,
+    tie_word_embeddings=True,
+)
+
+LLAMA_3B = ModelConfig(  # Llama-3.2-3B shape
+    vocab_size=128256,
+    hidden_size=3072,
+    intermediate_size=8192,
+    num_layers=28,
+    num_heads=24,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=500000.0,
+    max_position_embeddings=8192,
+    tie_word_embeddings=True,
+)
+
+LLAMA_8B = ModelConfig(  # Llama-3.1-8B / DeepSeek-R1-Distill-Llama-8B shape
+    vocab_size=128256,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    rope_theta=500000.0,
+    max_position_embeddings=8192,
+)
+
+PRESETS = {"tiny": TINY, "llama-1b": LLAMA_1B, "llama-3b": LLAMA_3B, "llama-8b": LLAMA_8B}

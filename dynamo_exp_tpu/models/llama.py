@@ -1,0 +1,191 @@
+"""Llama-family transformer, functional JAX, paged-KV, scan-over-layers.
+
+TPU-first design decisions (vs the reference's delegation to vLLM/sglang,
+SURVEY.md §2.3):
+
+- **Stacked layer parameters + ``lax.scan``**: one compiled layer body,
+  L-step scan. Compile time stays flat as L grows, and XLA pipelines the
+  per-layer HBM traffic.
+- **One forward for prefill and decode**: write-then-gather paged
+  attention (see ``ops/attention.py``) with static (B, T, Pmax) buckets.
+- **bfloat16 everywhere the MXU touches**, float32 for norms/softmax/rope.
+- **GSPMD tensor parallelism**: parameters carry head/ffn-sharded
+  ``PartitionSpec``s (see ``param_shardings``); collectives are inserted
+  by XLA over ICI, not hand-written.
+
+Reference capability anchor: the engines in
+``/root/reference/lib/engines/`` expose token-in/token-out forward passes;
+this module is their TPU-native replacement.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import paged_attention, write_kv_pages
+from ..ops.rope import apply_rope, rope_frequencies
+from .config import ModelConfig
+
+Params = dict[str, Any]
+
+
+def _dtype(cfg: ModelConfig):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.bfloat16}[
+        str(cfg.dtype)
+    ]
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Random-init parameters (tests, benchmarks, and shape reference)."""
+    dt = _dtype(cfg)
+    hd = cfg.head_dim_
+    L, D, H, Hkv, I, V = (
+        cfg.num_layers,
+        cfg.hidden_size,
+        cfg.num_heads,
+        cfg.num_kv_heads,
+        cfg.intermediate_size,
+        cfg.vocab_size,
+    )
+    ks = jax.random.split(rng, 10)
+
+    def init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(dt)
+
+    params: Params = {
+        "embed": init(ks[0], (V, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dt),
+            "wq": init(ks[1], (L, D, H * hd), D),
+            "wk": init(ks[2], (L, D, Hkv * hd), D),
+            "wv": init(ks[3], (L, D, Hkv * hd), D),
+            "wo": init(ks[4], (L, H * hd, D), H * hd),
+            "mlp_norm": jnp.ones((L, D), dt),
+            "w_gate": init(ks[5], (L, D, I), D),
+            "w_up": init(ks[6], (L, D, I), D),
+            "w_down": init(ks[7], (L, I, D), I),
+        },
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = init(ks[8], (D, V), D)
+    return params
+
+
+def param_shardings(cfg: ModelConfig, tp_axis: str = "tp") -> Params:
+    """PartitionSpec pytree matching ``init_params``: megatron-style TP —
+    QKV/gate/up column-sharded over heads/ffn, O/down row-sharded, embed
+    and lm_head vocab-sharded."""
+    specs: Params = {
+        "embed": P(tp_axis, None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, tp_axis),
+            "wk": P(None, None, tp_axis),
+            "wv": P(None, None, tp_axis),
+            "wo": P(None, tp_axis, None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, tp_axis),
+            "w_up": P(None, None, tp_axis),
+            "w_down": P(None, tp_axis, None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, tp_axis)
+    return specs
+
+
+def kv_cache_shardings(tp_axis: str = "tp") -> tuple[P, P]:
+    """KV page pools are sharded over kv heads: [L, P, ps, Hkv, D]."""
+    spec = P(None, None, None, tp_axis, None)
+    return spec, spec
+
+
+def init_kv_cache(
+    cfg: ModelConfig, num_pages: int, page_size: int, dtype=None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Allocate the paged KV pools: each [L, num_pages, ps, Hkv, D]."""
+    dt = dtype or _dtype(cfg)
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32 (pad with 0 where pos < 0)
+    positions: jnp.ndarray,  # [B, T] int32, -1 for padding rows
+    page_table: jnp.ndarray,  # [B, Pmax] int32
+    k_cache: jnp.ndarray,  # [L, P, ps, Hkv, D]
+    v_cache: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One forward step (prefill or decode by bucket shape).
+
+    Writes new K/V into the paged pools, attends, and returns
+    (logits [B, T, V] float32, new_k_cache, new_v_cache).
+    """
+    B, T = tokens.shape
+    hd = cfg.head_dim_
+    ps = k_cache.shape[2]
+    eps = cfg.rms_norm_eps
+    inv_freq = rope_frequencies(hd, cfg.rope_theta, cfg.rope_scaling)
+
+    # Page-write coordinates, shared by every layer. Positions beyond the
+    # page table's capacity are dropped (not clamped): a scheduler bug can
+    # truncate a sequence but never silently corrupt another's pages.
+    flat_pos = positions.reshape(-1)  # [B*T]
+    safe_pos = jnp.maximum(flat_pos, 0)
+    page_in_seq = safe_pos // ps
+    valid = (flat_pos >= 0) & (page_in_seq < page_table.shape[1])
+    batch_idx = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)
+    page_ids = page_table[batch_idx, page_in_seq]  # [B*T]
+    offsets = safe_pos % ps
+
+    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+
+    def layer(x, layer_in):
+        lp, k_pool, v_pool = layer_in
+        h = rms_norm(x, lp["attn_norm"], eps)
+        q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, hd)
+        k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, hd)
+        v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, hd)
+        pos_for_rope = jnp.maximum(positions, 0)
+        q = apply_rope(q, pos_for_rope, inv_freq)
+        k = apply_rope(k, pos_for_rope, inv_freq)
+
+        k_pool, v_pool = write_kv_pages(
+            k_pool,
+            v_pool,
+            k.reshape(B * T, cfg.num_kv_heads, hd),
+            v.reshape(B * T, cfg.num_kv_heads, hd),
+            page_ids,
+            offsets,
+            valid,
+        )
+        attn = paged_attention(q, k_pool, v_pool, page_table, positions)
+        x = x + attn.reshape(B, T, cfg.num_heads * hd) @ lp["wo"]
+
+        h = rms_norm(x, lp["mlp_norm"], eps)
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        return x, (k_pool, v_pool)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], k_cache, v_cache)
+    )
+
+    x = rms_norm(x, params["final_norm"], eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, new_k, new_v

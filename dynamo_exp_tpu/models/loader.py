@@ -1,0 +1,86 @@
+"""Load Llama-family HF checkpoints (safetensors) into stacked JAX params.
+
+The reference leaves weight loading to the wrapped engines (and its own
+GGUF loader, ``/root/reference/lib/llm/src/gguf.rs``). Here checkpoints
+are read tensor-by-tensor from safetensors, transposed to the matmul
+layout ``x @ W`` used by ``models/llama.py``, and stacked along a leading
+layer axis for the scan.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+from .llama import Params
+
+
+def _open_safetensors(path: str):
+    from safetensors import safe_open  # lazy: only needed for real ckpts
+
+    files = sorted(
+        os.path.join(path, f)
+        for f in os.listdir(path)
+        if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {path}")
+    handles = [safe_open(f, framework="numpy") for f in files]
+    index: dict[str, int] = {}
+    for i, h in enumerate(handles):
+        for name in h.keys():
+            index[name] = i
+    return handles, index
+
+
+def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, ModelConfig]:
+    """Load a HF Llama checkpoint directory into the stacked param pytree."""
+    if cfg is None:
+        cfg = ModelConfig.from_pretrained(path)
+    handles, index = _open_safetensors(path)
+    dt = jnp.bfloat16 if cfg.dtype != "float32" else jnp.float32
+
+    def get(name: str) -> np.ndarray:
+        arr = handles[index[name]].get_tensor(name)
+        if arr.dtype == np.dtype("V2"):  # raw bf16 comes out as void16
+            arr = arr.view(np.uint16)
+            arr = (arr.astype(np.uint32) << 16).view(np.float32)
+        return arr
+
+    def linear(name: str) -> np.ndarray:
+        # HF stores [out, in]; we use x @ W so transpose to [in, out].
+        return get(name).T
+
+    pre = "model."
+    L = cfg.num_layers
+    layers: dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+        "w_gate", "w_up", "w_down",
+    )}
+    for i in range(L):
+        p = f"{pre}layers.{i}."
+        layers["attn_norm"].append(get(p + "input_layernorm.weight"))
+        layers["wq"].append(linear(p + "self_attn.q_proj.weight"))
+        layers["wk"].append(linear(p + "self_attn.k_proj.weight"))
+        layers["wv"].append(linear(p + "self_attn.v_proj.weight"))
+        layers["wo"].append(linear(p + "self_attn.o_proj.weight"))
+        layers["mlp_norm"].append(get(p + "post_attention_layernorm.weight"))
+        layers["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
+        layers["w_up"].append(linear(p + "mlp.up_proj.weight"))
+        layers["w_down"].append(linear(p + "mlp.down_proj.weight"))
+
+    params: Params = {
+        "embed": jnp.asarray(get(pre + "embed_tokens.weight"), dt),
+        "layers": {
+            k: jnp.asarray(np.stack(v), dt) for k, v in layers.items()
+        },
+        "final_norm": jnp.asarray(get(pre + "norm.weight"), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(linear("lm_head.weight"), dt)
+    handles.clear()  # drop mmap handles now rather than at caller GC
+    return params, cfg

@@ -1,0 +1,13 @@
+from .attention import dense_causal_attention, paged_attention, write_kv_pages
+from .rope import apply_rope, rope_frequencies
+from .sampling import apply_penalties, sample_tokens
+
+__all__ = [
+    "paged_attention",
+    "dense_causal_attention",
+    "write_kv_pages",
+    "apply_rope",
+    "rope_frequencies",
+    "sample_tokens",
+    "apply_penalties",
+]

@@ -1,0 +1,105 @@
+"""Paged attention over a page-table-indexed KV cache (XLA reference path).
+
+Design (TPU-first, replaces what vLLM's PagedAttention CUDA kernels gave the
+reference for free — see SURVEY.md §2.9):
+
+- The KV cache is a global page pool per layer: ``[num_pages, page_size,
+  num_kv_heads, head_dim]``. Sequences own pages via a per-slot page table.
+- Write-then-gather: a step first scatters its new K/V into the pool at
+  (page_table[pos // ps], pos % ps), then attention gathers the sequence's
+  pages and masks by position. Prefill (B=1, T=bucket) and decode
+  (B=slots, T=1) share one code path, so prefix-cache hits need no special
+  attention kernel — cached pages are simply already written.
+- Static shapes throughout: page tables are fixed width, masks handle the
+  ragged reality, so XLA compiles once per (B, T, Pmax) bucket.
+
+This module is the always-correct XLA path and the CPU-mesh test oracle;
+a fused Pallas kernel for the decode gather is the planned fast path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def write_kv_pages(
+    k_cache: jnp.ndarray,  # [P, ps, Hkv, D]
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,  # [N, Hkv, D] flattened new tokens
+    v_new: jnp.ndarray,
+    page_ids: jnp.ndarray,  # [N] int32 global page id per new token
+    offsets: jnp.ndarray,  # [N] int32 in-page offset per new token
+    valid: jnp.ndarray,  # [N] bool — False rows are dropped
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V rows into the page pool. Invalid rows are given an
+    out-of-range page id, which XLA's ``mode="drop"`` scatter discards —
+    no write happens for them at all."""
+    num_pages = k_cache.shape[0]
+    # Out-of-range page id for invalid rows => XLA drops the scatter row.
+    safe_pages = jnp.where(valid, page_ids, num_pages)
+    k_cache = k_cache.at[safe_pages, offsets].set(
+        k_new.astype(k_cache.dtype), mode="drop"
+    )
+    v_cache = v_cache.at[safe_pages, offsets].set(
+        v_new.astype(v_cache.dtype), mode="drop"
+    )
+    return k_cache, v_cache
+
+
+def paged_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k_cache: jnp.ndarray,  # [P, ps, Hkv, D]
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, Pmax] int32
+    q_positions: jnp.ndarray,  # [B, T] int32 global position of each query
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal attention of queries against their sequences' pages.
+
+    Returns [B, T, H, D]. Positions beyond a query's own position are
+    masked, so garbage in not-yet-written slots never leaks.
+    """
+    B, T, H, D = q.shape
+    P, ps, Hkv, _ = k_cache.shape
+    S = page_table.shape[1] * ps
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+
+    # Gather this batch's pages: [B, Pmax, ps, Hkv, D] -> [B, S, Hkv, D]
+    k = k_cache[page_table].reshape(B, S, Hkv, D)
+    v = v_cache[page_table].reshape(B, S, Hkv, D)
+
+    qpk = H // Hkv
+    qg = q.reshape(B, T, Hkv, qpk, D).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("bthqd,bshd->bhqts", qg, kf) * scale  # [B,Hkv,qpk,T,S]
+
+    kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, None, None, :]
+    mask = kv_pos <= q_positions[:, None, None, :, None]  # causal by position
+    scores = jnp.where(mask, scores, -1e30)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqts,bshd->bthqd", probs, v.astype(jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)
+
+
+def dense_causal_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Plain causal attention (no cache) — used by tests as the oracle and
+    by the ring-attention building block."""
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    qpk = H // Hkv
+    scale = sm_scale if sm_scale is not None else D ** -0.5
+    qg = q.reshape(B, T, Hkv, qpk, D).astype(jnp.float32)
+    scores = jnp.einsum("bthqd,bshd->bhqts", qg, k.astype(jnp.float32)) * scale
+    i = jnp.arange(T)[:, None]
+    j = jnp.arange(T)[None, :]
+    scores = jnp.where(j <= i, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqts,bshd->bthqd", probs, v.astype(jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)

@@ -1,0 +1,66 @@
+"""Rotary position embeddings (Llama-style, with Llama-3 rope scaling).
+
+TPU notes: computed in float32 then cast back — RoPE precision matters for
+long context, and the VPU handles the elementwise work fused into the
+surrounding matmuls by XLA.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, theta: float, scaling: dict | None = None
+) -> jnp.ndarray:
+    """Inverse frequencies [head_dim/2], with optional llama3 scaling."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if not scaling:
+        return inv_freq
+    kind = scaling.get("rope_type", scaling.get("type"))
+    if kind in (None, "default"):
+        return inv_freq
+    if kind == "linear":
+        return inv_freq / scaling["factor"]
+    if kind != "llama3":
+        raise ValueError(f"unsupported rope_scaling type: {kind!r}")
+    factor = scaling["factor"]
+    low = scaling.get("low_freq_factor", 1.0)
+    high = scaling.get("high_freq_factor", 4.0)
+    old_ctx = scaling.get("original_max_position_embeddings", 8192)
+    wavelen = 2 * math.pi / inv_freq
+    low_wl = old_ctx / low
+    high_wl = old_ctx / high
+    smooth = (old_ctx / wavelen - low) / (high - low)
+    return jnp.where(
+        wavelen > low_wl,
+        inv_freq / factor,
+        jnp.where(
+            wavelen < high_wl,
+            inv_freq,
+            (1 - smooth) * inv_freq / factor + smooth * inv_freq,
+        ),
+    )
+
+
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate ``x``: [..., T, H, D] by per-token ``positions``: [..., T].
+
+    Uses the HF "half-split" convention (rotate_half), matching Llama
+    checkpoints: pairs are (x[i], x[i + D/2]).
+    """
+    dtype = x.dtype
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, D/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, D/2]
+    sin = jnp.sin(angles)[..., None, :]
+    xf = x.astype(jnp.float32)
+    half = xf.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)

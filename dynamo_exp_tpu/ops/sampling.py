@@ -1,0 +1,77 @@
+"""Token sampling under jit: greedy / temperature / top-k / top-p.
+
+All sampling parameters are per-slot vectors so one compiled decode step
+serves a heterogeneous continuous batch — no recompile when a request with
+different sampling options joins. Branch-free (``jnp.where``), static
+shapes, so XLA keeps the whole step fused on-device.
+
+Capability parity: the reference forwards SamplingOptions to vLLM/sglang
+(``/root/reference/lib/llm/src/protocols/common.rs`` SamplingOptions);
+here the sampler is ours.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    rng: jax.Array,  # PRNG key
+    temperature: jnp.ndarray,  # [B] float32; <=0 means greedy
+    top_k: jnp.ndarray,  # [B] int32; <=0 disables
+    top_p: jnp.ndarray,  # [B] float32; >=1 disables
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # Sort once (descending); reuse for both top-k and top-p masks.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+
+    # top-k: threshold at the k-th largest logit.
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)  # [B,1]
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of sorted probs with
+    # cumsum >= p; a sorted logit is kept if the cumulative probability
+    # *before* it is still < p.
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    keep_sorted = (cum - probs_sorted) < jnp.clip(top_p, 0.0, 1.0)[:, None]
+    # The top token always survives, so top_p=0.0 degrades to greedy
+    # rather than masking the whole vocabulary.
+    keep_sorted = keep_sorted.at[:, 0].set(True)
+    # Map the sorted keep-mask back to a per-token logit threshold: the
+    # smallest sorted logit still kept.
+    min_kept = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    masked = jnp.where(scaled < min_kept, -jnp.inf, masked)
+
+    sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V]
+    output_counts: jnp.ndarray,  # [B, V] int32 — counts of generated tokens
+    frequency_penalty: jnp.ndarray,  # [B]
+    presence_penalty: jnp.ndarray,  # [B]
+    repetition_penalty: jnp.ndarray,  # [B]; 1.0 disables
+) -> jnp.ndarray:
+    """OpenAI-style frequency/presence penalties + HF repetition penalty."""
+    counts = output_counts.astype(logits.dtype)
+    logits = logits - counts * frequency_penalty[:, None]
+    logits = logits - (counts > 0) * presence_penalty[:, None]
+    rep = jnp.where(repetition_penalty <= 0.0, 1.0, repetition_penalty)[:, None]
+    seen = counts > 0
+    logits = jnp.where(
+        seen, jnp.where(logits > 0, logits / rep, logits * rep), logits
+    )
+    return logits

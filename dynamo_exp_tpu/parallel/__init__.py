@@ -1,0 +1,9 @@
+from .mesh import build_mesh, largest_tp, shard, shard_pytree, single_device_mesh
+
+__all__ = [
+    "build_mesh",
+    "single_device_mesh",
+    "shard",
+    "shard_pytree",
+    "largest_tp",
+]

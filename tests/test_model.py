@@ -1,0 +1,198 @@
+"""Model-layer correctness: paged incremental forward == dense oracle,
+TP-sharded forward == single-device forward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.models import (
+    TINY,
+    forward,
+    init_kv_cache,
+    init_params,
+    kv_cache_shardings,
+    param_shardings,
+)
+from dynamo_exp_tpu.ops import dense_causal_attention, paged_attention, write_kv_pages
+from dynamo_exp_tpu.parallel import build_mesh, shard_pytree
+
+
+PS = 8  # page size
+
+
+def _full_forward_logits(params, cfg, token_list):
+    """Oracle: run the whole sequence in one prefill pass, fresh cache."""
+    T = len(token_list)
+    pmax = (T + PS - 1) // PS
+    k, v = init_kv_cache(cfg, num_pages=pmax + 1, page_size=PS, dtype=jnp.float32)
+    tokens = jnp.array([token_list], dtype=jnp.int32)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    table = jnp.arange(pmax, dtype=jnp.int32)[None, :] + 1  # pages 1..pmax
+    logits, _, _ = forward(params, cfg, tokens, positions, table, k, v)
+    return np.asarray(logits[0])
+
+
+def test_paged_attention_matches_dense():
+    key = jax.random.PRNGKey(0)
+    B, T, H, Hkv, D = 2, 16, 4, 2, 8
+    q = jax.random.normal(key, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, T, Hkv, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, T, Hkv, D), jnp.float32)
+
+    want = dense_causal_attention(q, k, v)
+
+    # Put k/v into pages: each batch row owns its own pages.
+    pmax = T // PS
+    kc = jnp.zeros((B * pmax + 1, PS, Hkv, D))
+    vc = jnp.zeros_like(kc)
+    table = (jnp.arange(B * pmax, dtype=jnp.int32).reshape(B, pmax)) + 1
+    pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
+    flat_pos = pos.reshape(-1)
+    bidx = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)
+    page_ids = table[bidx, flat_pos // PS]
+    kc, vc = write_kv_pages(
+        kc, vc,
+        k.reshape(B * T, Hkv, D), v.reshape(B * T, Hkv, D),
+        page_ids, flat_pos % PS, jnp.ones(B * T, bool),
+    )
+    got = paged_attention(q, kc, vc, table, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_incremental_decode_matches_full_prefill():
+    cfg = TINY
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.float32), init_params(jax.random.PRNGKey(7), cfg)
+    )
+    toks = list(np.random.RandomState(0).randint(1, cfg.vocab_size, size=21))
+
+    want = _full_forward_logits(params, cfg, toks)
+
+    # Incremental: prefill first 13 tokens, then decode one at a time.
+    pmax = 4
+    k, v = init_kv_cache(cfg, num_pages=pmax + 1, page_size=PS, dtype=jnp.float32)
+    table = jnp.arange(pmax, dtype=jnp.int32)[None, :] + 1
+    split = 13
+    tokens = jnp.array([toks[:split]], dtype=jnp.int32)
+    positions = jnp.arange(split, dtype=jnp.int32)[None, :]
+    logits, k, v = forward(params, cfg, tokens, positions, table, k, v)
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), want[:split], rtol=1e-4, atol=1e-4
+    )
+    for i in range(split, len(toks)):
+        tok = jnp.array([[toks[i]]], dtype=jnp.int32)
+        pos = jnp.array([[i]], dtype=jnp.int32)
+        logits, k, v = forward(params, cfg, tok, pos, table, k, v)
+        np.testing.assert_allclose(
+            np.asarray(logits[0, 0]), want[i], rtol=1e-4, atol=1e-4
+        )
+
+
+def test_padding_rows_do_not_corrupt_cache():
+    """Inactive decode slots (position == -1) must not write KV anywhere."""
+    cfg = TINY
+    params = init_params(jax.random.PRNGKey(3), cfg)
+    k, v = init_kv_cache(cfg, num_pages=8, page_size=PS)
+    table = jnp.array([[1, 2], [3, 4]], dtype=jnp.int32)
+    tokens = jnp.array([[5], [0]], dtype=jnp.int32)
+    positions = jnp.array([[0], [-1]], dtype=jnp.int32)  # slot 1 inactive
+    _, k2, v2 = forward(params, cfg, tokens, positions, table, k, v)
+    # Slot 1's pages (3, 4) must be untouched.
+    np.testing.assert_array_equal(np.asarray(k2[:, 3:5]), np.asarray(k[:, 3:5]))
+    # Slot 0 wrote page 1 offset 0.
+    assert np.abs(np.asarray(k2[:, 1, 0])).sum() > 0
+
+
+def test_tp_sharded_forward_matches_single_device():
+    cfg = TINY  # 2 kv heads -> tp=2
+    params = init_params(jax.random.PRNGKey(11), cfg)
+    toks = list(np.random.RandomState(1).randint(1, cfg.vocab_size, size=9))
+    want = _full_forward_logits(
+        jax.tree.map(lambda x: x.astype(jnp.float32), params), cfg, toks
+    )
+
+    mesh = build_mesh(tp=2)
+    sp, _ = shard_pytree(mesh, params, param_shardings(cfg))
+    T = len(toks)
+    pmax = (T + PS - 1) // PS
+    kspec, vspec = kv_cache_shardings()
+    k, v = init_kv_cache(cfg, num_pages=pmax + 1, page_size=PS)
+    from jax.sharding import NamedSharding
+
+    k = jax.device_put(k, NamedSharding(mesh, kspec))
+    v = jax.device_put(v, NamedSharding(mesh, vspec))
+    tokens = jnp.array([toks], dtype=jnp.int32)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    table = jnp.arange(pmax, dtype=jnp.int32)[None, :] + 1
+    fwd = jax.jit(forward, static_argnums=(1,))
+    logits, _, _ = fwd(sp, cfg, tokens, positions, table, k, v)
+    # bf16 params => loose tolerance; checking agreement not exactness.
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), want, rtol=0.1, atol=0.15
+    )
+
+
+def test_sampling_greedy_and_topk():
+    from dynamo_exp_tpu.ops import sample_tokens
+
+    logits = jnp.array([[0.0, 5.0, 1.0, 2.0], [3.0, 0.0, 0.0, 0.0]], jnp.float32)
+    out = sample_tokens(
+        logits,
+        jax.random.PRNGKey(0),
+        temperature=jnp.zeros(2),
+        top_k=jnp.zeros(2, jnp.int32),
+        top_p=jnp.ones(2),
+    )
+    np.testing.assert_array_equal(np.asarray(out), [1, 0])
+    # top_k=1 at any temperature is greedy.
+    out = sample_tokens(
+        logits,
+        jax.random.PRNGKey(1),
+        temperature=jnp.full(2, 0.9),
+        top_k=jnp.ones(2, jnp.int32),
+        top_p=jnp.ones(2),
+    )
+    np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+
+def test_model_config_hashable_with_rope_scaling():
+    from dynamo_exp_tpu.models import ModelConfig
+
+    cfg = ModelConfig.from_hf_config(
+        {"rope_scaling": {"rope_type": "llama3", "factor": 8.0}}
+    )
+    hash(cfg)  # must be usable as a jit static argument
+
+    from dynamo_exp_tpu.ops import rope_frequencies
+
+    base = rope_frequencies(8, 10000.0)
+    lin = rope_frequencies(8, 10000.0, {"type": "linear", "factor": 4.0})
+    np.testing.assert_allclose(np.asarray(lin), np.asarray(base) / 4.0)
+    with pytest.raises(ValueError):
+        rope_frequencies(8, 10000.0, {"type": "yarn", "factor": 2.0})
+
+
+def test_top_p_zero_degrades_to_greedy():
+    from dynamo_exp_tpu.ops import sample_tokens
+
+    logits = jnp.array([[0.0, 5.0, 1.0, 2.0]], jnp.float32)
+    out = sample_tokens(
+        logits,
+        jax.random.PRNGKey(0),
+        temperature=jnp.full(1, 1.0),
+        top_k=jnp.zeros(1, jnp.int32),
+        top_p=jnp.zeros(1),
+    )
+    np.testing.assert_array_equal(np.asarray(out), [1])
+
+
+def test_position_beyond_page_table_is_dropped_not_clamped():
+    cfg = TINY
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    k, v = init_kv_cache(cfg, num_pages=4, page_size=PS)
+    table = jnp.array([[1, 2]], dtype=jnp.int32)  # capacity = 2 pages
+    tokens = jnp.array([[7]], dtype=jnp.int32)
+    positions = jnp.array([[2 * PS]], dtype=jnp.int32)  # one past capacity
+    _, k2, _ = forward(params, cfg, tokens, positions, table, k, v)
+    np.testing.assert_array_equal(np.asarray(k2), np.asarray(k))  # no write
