@@ -1,0 +1,107 @@
+"""Benchmark: engine decode throughput on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (mirrors the reference harness shape, scaled to one chip —
+``/root/reference/examples/llm/benchmarks/perf.sh``: fixed ISL/OSL,
+concurrency saturating the engine, streaming): N concurrent requests,
+ISL 128 random tokens, OSL 64, through the full engine path (continuous
+batching, paged KV, sampling).
+
+``vs_baseline`` is measured tok/s divided by the single-chip HBM
+roofline for this model (weights are re-read every decode step, so
+steps/s <= HBM_BW / weight_bytes; tokens/s <= steps/s * batch). This is
+an honest hardware-efficiency fraction rather than a cross-hardware
+comparison the reference never published absolute numbers for
+(SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+MODEL = "llama-1b"
+ISL = 128
+OSL = 64
+CONCURRENCY = 32
+HBM_GBPS = 819.0  # TPU v5e
+
+
+def main() -> None:
+    import jax
+
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models import PRESETS
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    mcfg = PRESETS[MODEL]
+    cfg = EngineConfig(
+        model=mcfg,
+        max_decode_slots=CONCURRENCY,
+        page_size=16,
+        num_pages=CONCURRENCY * ((ISL + OSL) // 16 + 2) + 64,
+        max_model_len=512,
+        prefill_buckets=[ISL],
+        eos_token_ids=[],
+    )
+    engine = TPUEngine(cfg, seed=0)
+    engine.start()
+
+    rs = np.random.RandomState(0)
+    prompts = [
+        rs.randint(10, mcfg.vocab_size - 10, size=ISL).tolist()
+        for _ in range(CONCURRENCY)
+    ]
+
+    async def run_one(prompt):
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = OSL
+        b.stop_conditions.ignore_eos = True
+        stream = await engine.generate(b.to_dict())
+        n = 0
+        ttft = None
+        t0 = time.perf_counter()
+        async for item in stream:
+            if item.get("token_ids") and ttft is None:
+                ttft = time.perf_counter() - t0
+            n += len(item.get("token_ids", []))
+        return n, ttft
+
+    async def sweep():
+        # Warmup: compile prefill + decode programs.
+        await run_one(prompts[0])
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*[run_one(p) for p in prompts])
+        dt = time.perf_counter() - t0
+        total = sum(n for n, _ in results)
+        ttfts = sorted(t for _, t in results if t is not None)
+        return total / dt, ttfts[len(ttfts) // 2]
+
+    tok_s, p50_ttft = asyncio.run(sweep())
+    engine.stop()
+
+    weight_bytes = sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree.leaves(engine.params)
+    )
+    roofline = HBM_GBPS * 1e9 / weight_bytes * CONCURRENCY
+    print(
+        json.dumps(
+            {
+                "metric": f"decode_throughput_{MODEL}_isl{ISL}_osl{OSL}_c{CONCURRENCY}",
+                "value": round(tok_s, 1),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_s / roofline, 4),
+                "p50_ttft_s": round(p50_ttft, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+from .config import EngineConfig
+from .engine import TPUEngine
+from .kv_manager import KvEvent, KvPageManager
+from .scheduler import Scheduler, Sequence
+
+__all__ = [
+    "EngineConfig",
+    "TPUEngine",
+    "KvPageManager",
+    "KvEvent",
+    "Scheduler",
+    "Sequence",
+]

@@ -1,0 +1,58 @@
+"""Engine configuration.
+
+The reference passes engine knobs through to vLLM/sglang
+(``/root/reference/launch/dynamo-run/src/flags.rs:26-238``); here they
+configure our own continuous-batching TPU engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..models.config import ModelConfig
+
+
+def default_prefill_buckets(max_len: int) -> list[int]:
+    buckets = []
+    b = 16
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+@dataclass
+class EngineConfig:
+    model: ModelConfig
+    # Continuous-batching shape envelope (all static for XLA).
+    max_decode_slots: int = 8  # B of the decode step
+    page_size: int = 16  # tokens per KV page (also the reuse-hash block)
+    num_pages: int = 512  # global page pool size
+    max_model_len: int = 2048  # per-sequence token capacity
+    prefill_buckets: list[int] = field(default_factory=list)
+    # Parallelism within this engine replica.
+    tp: int = 1
+    sp: int = 1
+    # Sampling defaults when the request leaves them unset.
+    default_max_tokens: int = 256
+    eos_token_ids: list[int] = field(default_factory=list)
+    # KV cache dtype ("bfloat16" | "float32").
+    kv_dtype: str = "bfloat16"
+    # Emit KV stored/removed events for the router index.
+    enable_kv_events: bool = True
+
+    def __post_init__(self):
+        if not self.prefill_buckets:
+            self.prefill_buckets = default_prefill_buckets(self.max_model_len)
+        self.prefill_buckets = sorted(set(self.prefill_buckets))
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return (self.max_model_len + self.page_size - 1) // self.page_size
+
+    def bucket_for(self, n: int) -> int | None:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return None

@@ -1,0 +1,220 @@
+"""Host-side KV page pool: allocation, content-addressed prefix reuse,
+LRU eviction, and KV event emission.
+
+Capability parity with the reference's KV block manager
+(``/root/reference/lib/llm/src/kv/reuse.rs:50-760`` — the
+``AvailableBlocks`` match/take/update actor — and ``kv/manager.rs:22-168``
+G1/G2 tiers), redesigned for the TPU engine:
+
+- Device pages live in the paged pools allocated by ``models/llama.py``;
+  this manager only tracks *ids* — all data movement happens inside the
+  jitted forward (writes) or via host offload (``offload.py``).
+- Reuse is content-addressed by the chained sequence hash of each full
+  page (``tokens.py``), so a new request's prompt prefix maps onto pages
+  already resident in HBM; matched pages are ref-counted, and pages whose
+  refs drop to zero park in an LRU from which they can be revived (hit)
+  or evicted (miss → reallocated).
+- Every registered/evicted full page emits a KV event (stored/removed)
+  through a callback — the feed for the KV-aware router's radix index
+  (reference: ``lib/llm/src/kv_router/publisher.rs:34-139``).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+from ..tokens import compute_block_hashes_for_seq
+
+
+@dataclass
+class PageRecord:
+    page_id: int
+    seq_hash: int | None = None  # None until the page is full + registered
+    ref_count: int = 0
+
+
+@dataclass
+class KvEvent:
+    """Stored/removed notification for the router's radix index."""
+
+    kind: str  # "stored" | "removed"
+    seq_hashes: list[int]
+    parent_hash: int | None = None
+    token_blocks: list[list[int]] | None = None  # only on stored
+    ts: float = field(default_factory=time.time)
+
+
+class KvPageManager:
+    """Tracks ownership and reuse of the device page pool by id.
+
+    Not thread-safe by design: owned by the engine loop thread, the same
+    single-writer discipline the reference uses for its block pool actor.
+    """
+
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        event_cb: Callable[[KvEvent], None] | None = None,
+    ):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.event_cb = event_cb
+        self._records: dict[int, PageRecord] = {
+            i: PageRecord(i) for i in range(num_pages)
+        }
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        # seq_hash -> page_id for every registered full page still resident.
+        self._by_hash: dict[int, int] = {}
+        # Zero-ref registered pages, LRU order (oldest first).
+        self._reclaimable: OrderedDict[int, None] = OrderedDict()
+        # Metrics counters.
+        self.hits = 0
+        self.misses = 0
+
+    # ---------------------------------------------------------------- stats
+    @property
+    def free_pages(self) -> int:
+        return len(self._free) + len(self._reclaimable)
+
+    @property
+    def active_pages(self) -> int:
+        return self.num_pages - self.free_pages
+
+    @property
+    def usage(self) -> float:
+        return self.active_pages / max(self.num_pages, 1)
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    # ------------------------------------------------------------ allocation
+    def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], list[int]]:
+        """Longest resident prefix of ``tokens`` in full pages.
+
+        Returns (page_ids, seq_hashes) of the matched prefix — does NOT
+        take references; call ``allocate_sequence`` to commit.
+        """
+        hashes = compute_block_hashes_for_seq(tokens, self.page_size)
+        pages: list[int] = []
+        matched: list[int] = []
+        for h in hashes:
+            pid = self._by_hash.get(h)
+            if pid is None:
+                break
+            pages.append(pid)
+            matched.append(h)
+        return pages, matched
+
+    def allocate_sequence(
+        self, tokens: Sequence[int], max_pages: int
+    ) -> tuple[list[int], int] | None:
+        """Pages for a new sequence: reuse the longest cached prefix, then
+        fresh pages for the rest of the prompt.
+
+        Returns (page_ids, cached_len) or None if the pool can't satisfy
+        the request right now (caller re-queues).
+        ``page_ids`` covers ceil(len(tokens)/ps) pages; the trailing
+        partial page is fresh. cached_len is a multiple of page_size.
+        """
+        ps = self.page_size
+        need_total = (len(tokens) + ps - 1) // ps
+        if need_total > max_pages:
+            return None  # exceeds per-sequence capacity; caller must reject
+        matched_pages, matched_hashes = self.match_prefix(tokens)
+        # Never reuse the *entire* prompt: the last token's KV must be
+        # recomputed into a page this sequence owns so decode can append.
+        while matched_pages and len(matched_pages) * ps >= len(tokens):
+            matched_pages.pop()
+            matched_hashes.pop()
+        need_fresh = need_total - len(matched_pages)
+        if need_fresh > self._available_for_take():
+            return None
+        for pid in matched_pages:  # commit the reuse
+            self._ref_page(pid)
+        fresh = [self._take_free() for _ in range(need_fresh)]
+        self.hits += len(matched_pages)
+        self.misses += need_fresh
+        return matched_pages + fresh, len(matched_pages) * ps
+
+    def allocate_page(self) -> int | None:
+        """One fresh page (decode crossing a page boundary)."""
+        if self._available_for_take() < 1:
+            return None
+        return self._take_free()
+
+    # ------------------------------------------------------------- lifecycle
+    def register_full_page(
+        self,
+        page_id: int,
+        seq_hash: int,
+        parent_hash: int | None = None,
+        tokens: list[int] | None = None,
+    ) -> None:
+        """A page just got its page_size-th token: make it reusable and
+        announce it to the router index."""
+        rec = self._records[page_id]
+        if rec.seq_hash == seq_hash:
+            return
+        # A different page may already hold this content (two requests with
+        # the same prompt racing); keep the first registration authoritative.
+        if seq_hash not in self._by_hash:
+            rec.seq_hash = seq_hash
+            self._by_hash[seq_hash] = page_id
+            if self.event_cb:
+                self.event_cb(
+                    KvEvent(
+                        "stored",
+                        [seq_hash],
+                        parent_hash=parent_hash,
+                        token_blocks=[tokens] if tokens else None,
+                    )
+                )
+
+    def release_sequence(self, page_ids: Sequence[int]) -> None:
+        """Sequence finished: drop refs. Registered pages park in the LRU
+        (still matchable); unregistered pages return to the free list."""
+        for pid in page_ids:
+            rec = self._records[pid]
+            if rec.ref_count > 0:
+                rec.ref_count -= 1
+            if rec.ref_count == 0:
+                if rec.seq_hash is not None:
+                    self._reclaimable[pid] = None
+                    self._reclaimable.move_to_end(pid)
+                else:
+                    self._free.append(pid)
+
+    # -------------------------------------------------------------- internal
+    def _available_for_take(self) -> int:
+        return len(self._free) + len(self._reclaimable)
+
+    def _ref_page(self, pid: int) -> None:
+        rec = self._records[pid]
+        if rec.ref_count == 0:
+            self._reclaimable.pop(pid, None)
+        rec.ref_count += 1
+
+    def _take_free(self) -> int:
+        if self._free:
+            pid = self._free.pop()
+        else:
+            # Evict the least-recently-used parked page.
+            pid, _ = self._reclaimable.popitem(last=False)
+            self._evict(pid)
+        rec = self._records[pid]
+        rec.ref_count = 1
+        rec.seq_hash = None
+        return pid
+
+    def _evict(self, pid: int) -> None:
+        rec = self._records[pid]
+        if rec.seq_hash is not None:
+            self._by_hash.pop(rec.seq_hash, None)
+            if self.event_cb:
+                self.event_cb(KvEvent("removed", [rec.seq_hash]))
+            rec.seq_hash = None

@@ -1,0 +1,198 @@
+"""Continuous-batching scheduler: sequences, decode slots, admission.
+
+This is the TPU replacement for the scheduling vLLM provided the
+reference for free (SURVEY.md §2.9). Single-writer: all mutation happens
+on the engine loop thread.
+
+Policy (v1): prefill-prioritized FCFS. When a decode slot is free and
+the page pool can hold the next waiting prompt, run one bucketed prefill
+and admit it; otherwise run one decode step over all active slots.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..protocols.common import BackendInput, FinishReason
+from ..tokens import chain_hash, compute_block_hash
+from .config import EngineConfig
+from .kv_manager import KvPageManager
+
+
+class SeqState(enum.Enum):
+    WAITING = "waiting"
+    ACTIVE = "active"
+    FINISHED = "finished"
+
+
+@dataclass
+class Sequence:
+    """One in-flight request's full engine-side state."""
+
+    request_id: str
+    prompt: list[int]
+    stop: "BackendInput"
+    emit: Callable[[list[int], FinishReason | None], None]
+    is_cancelled: Callable[[], bool]
+    state: SeqState = SeqState.WAITING
+    slot: int = -1
+    page_ids: list[int] = field(default_factory=list)
+    cached_len: int = 0  # prefix reused from the page pool
+    tokens: list[int] = field(default_factory=list)  # prompt + generated
+    generated: int = 0
+    # Chained hash state for registering full pages (router events + reuse).
+    parent_hash: int | None = None
+    hashed_pages: int = 0  # count of pages already registered
+    # Set when the pool ran dry mid-decode; slot idles until a page frees.
+    stalled: bool = False
+
+    @property
+    def pos(self) -> int:
+        """Next token position to be written."""
+        return len(self.tokens)
+
+    def last_token(self) -> int:
+        return self.tokens[-1]
+
+
+class Scheduler:
+    def __init__(self, cfg: EngineConfig, kv: KvPageManager):
+        self.cfg = cfg
+        self.kv = kv
+        self.waiting: deque[Sequence] = deque()
+        self.slots: list[Sequence | None] = [None] * cfg.max_decode_slots
+        self.active_count = 0
+
+    # --------------------------------------------------------------- intake
+    def submit(self, seq: Sequence) -> None:
+        self.waiting.append(seq)
+
+    def has_work(self) -> bool:
+        return self.active_count > 0 or bool(self.waiting)
+
+    def free_slot(self) -> int | None:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def next_prefill(self) -> Sequence | None:
+        """Pop the next admissible waiting sequence and bind it to a slot +
+        pages. Returns None if nothing can be admitted right now."""
+        while self.waiting:
+            if self.waiting[0].is_cancelled():
+                seq = self.waiting.popleft()
+                seq.state = SeqState.FINISHED
+                seq.emit([], FinishReason.CANCELLED)
+                continue
+            slot = self.free_slot()
+            if slot is None:
+                return None
+            seq = self.waiting[0]
+            if len(seq.prompt) > self.cfg.max_model_len or (
+                self.cfg.bucket_for(len(seq.prompt)) is None
+            ):
+                self.waiting.popleft()
+                seq.state = SeqState.FINISHED
+                seq.emit([], FinishReason.ERROR)
+                continue
+            alloc = self.kv.allocate_sequence(seq.prompt, self.cfg.max_pages_per_seq)
+            if alloc is None:
+                return None  # pool exhausted; retry after some decode frees
+            self.waiting.popleft()
+            seq.page_ids, seq.cached_len = alloc
+            seq.hashed_pages = seq.cached_len // self.kv.page_size
+            seq.parent_hash = self._hash_prefix(seq.prompt, seq.hashed_pages)
+            seq.tokens = list(seq.prompt)
+            seq.slot = slot
+            seq.state = SeqState.ACTIVE
+            self.slots[slot] = seq
+            self.active_count += 1
+            return seq
+        return None
+
+    def _hash_prefix(self, tokens: list[int], num_pages: int) -> int | None:
+        ps = self.kv.page_size
+        parent = None
+        for i in range(num_pages):
+            local = compute_block_hash(tokens[i * ps : (i + 1) * ps])
+            parent = chain_hash(parent, local)
+        return parent
+
+    # ------------------------------------------------------------- lifecycle
+    def ensure_decode_page(self, seq: Sequence, position: int) -> bool:
+        """Before writing ``position``: allocate a page on the boundary.
+        Returns False if the pool is dry (sequence stalls)."""
+        ps = self.kv.page_size
+        if position // ps < len(seq.page_ids):
+            seq.stalled = False
+            return True
+        pid = self.kv.allocate_page()
+        if pid is None:
+            seq.stalled = True
+            return False
+        seq.page_ids.append(pid)
+        seq.stalled = False
+        return True
+
+    def register_full_pages(self, seq: Sequence) -> None:
+        """Register every newly completed page for reuse + router events.
+
+        Only positions up to ``pos - 1`` have KV written (the newest
+        sampled token's KV lands on the next step), hence the -1."""
+        ps = self.kv.page_size
+        full = (seq.pos - 1) // ps
+        while seq.hashed_pages < full:
+            i = seq.hashed_pages
+            block = seq.tokens[i * ps : (i + 1) * ps]
+            local = compute_block_hash(block)
+            seq_hash = chain_hash(seq.parent_hash, local)
+            self.kv.register_full_page(
+                seq.page_ids[i], seq_hash, parent_hash=seq.parent_hash, tokens=block
+            )
+            seq.parent_hash = seq_hash
+            seq.hashed_pages += 1
+
+    def finish(self, seq: Sequence, reason: FinishReason) -> None:
+        if seq.state == SeqState.FINISHED:
+            return
+        seq.state = SeqState.FINISHED
+        if seq.slot >= 0:
+            self.slots[seq.slot] = None
+            self.active_count -= 1
+            seq.slot = -1
+        self.kv.release_sequence(seq.page_ids)
+        seq.emit([], reason)
+
+    # -------------------------------------------------------------- stopping
+    def check_stop(self, seq: Sequence, token: int) -> FinishReason | None:
+        sc = seq.stop.stop_conditions
+        min_tokens = sc.min_tokens or 0
+        if seq.generated >= min_tokens:
+            if not sc.ignore_eos and (
+                token in self.cfg.eos_token_ids or token in sc.stop_token_ids
+            ):
+                return FinishReason.EOS
+        max_tokens = sc.max_tokens or self.cfg.default_max_tokens
+        if seq.generated >= max_tokens:
+            return FinishReason.LENGTH
+        if seq.pos >= self.cfg.max_model_len:
+            return FinishReason.LENGTH
+        return None
+
+    # --------------------------------------------------------------- metrics
+    def metrics(self) -> dict:
+        """ForwardPassMetrics equivalent (reference:
+        ``lib/llm/src/kv_router/protocols.rs:43-55``)."""
+        return {
+            "request_active_slots": self.active_count,
+            "request_total_slots": self.cfg.max_decode_slots,
+            "kv_active_blocks": self.kv.active_pages,
+            "kv_total_blocks": self.kv.num_pages,
+            "num_requests_waiting": len(self.waiting),
+            "gpu_cache_usage_perc": self.kv.usage,
+            "gpu_prefix_cache_hit_rate": self.kv.hit_rate(),
+        }

@@ -1,0 +1,151 @@
+"""Global prefix index: which workers hold which KV blocks.
+
+Capability parity with ``/root/reference/lib/llm/src/kv_router/indexer.rs``
+(``RadixTree::{find_matches,apply_event,remove_worker}`` :239-391,
+``KvIndexer`` :499-608, ``KvIndexerSharded`` :677-790), redesigned around
+the chained-hash property of our blocks: because each block's sequence
+hash commits to its entire prefix (``tokens.py``), prefix containment is
+a chain walk — a flat ``hash -> workers`` map plus contiguity bookkeeping
+is equivalent to the reference's radix tree with O(1) updates.
+
+Single-writer: events are applied on the indexer's asyncio task, queries
+run on the same loop — the same discipline the reference enforces with
+its event channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+from collections import defaultdict
+from typing import Sequence
+
+from ..tokens import compute_block_hashes_for_seq
+from .protocols import KvCacheEventData, OverlapScores, RouterEvent
+
+logger = logging.getLogger(__name__)
+
+
+class RadixIndex:
+    """hash -> set(worker) with per-worker reverse index."""
+
+    def __init__(self):
+        self._workers_by_hash: dict[int, set[int]] = defaultdict(set)
+        self._hashes_by_worker: dict[int, set[int]] = defaultdict(set)
+
+    def apply_event(self, event: RouterEvent) -> None:
+        w = event.worker_id
+        data: KvCacheEventData = event.data
+        if data.kind == "stored":
+            for h in data.block_hashes:
+                self._workers_by_hash[h].add(w)
+                self._hashes_by_worker[w].add(h)
+        elif data.kind == "removed":
+            for h in data.block_hashes:
+                self._workers_by_hash.get(h, set()).discard(w)
+                self._hashes_by_worker.get(w, set()).discard(h)
+                if not self._workers_by_hash.get(h):
+                    self._workers_by_hash.pop(h, None)
+        else:
+            logger.warning("unknown kv event kind %r", data.kind)
+
+    def remove_worker(self, worker_id: int) -> None:
+        for h in self._hashes_by_worker.pop(worker_id, set()):
+            s = self._workers_by_hash.get(h)
+            if s is not None:
+                s.discard(worker_id)
+                if not s:
+                    self._workers_by_hash.pop(h, None)
+
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        """Longest contiguous matched prefix per worker: worker w scores
+        i+1 only if it held blocks 0..i."""
+        scores: dict[int, int] = {}
+        for i, h in enumerate(seq_hashes):
+            workers = self._workers_by_hash.get(h)
+            if not workers:
+                break
+            for w in workers:
+                if scores.get(w, 0) == i:
+                    scores[w] = i + 1
+            if not any(v == i + 1 for v in scores.values()):
+                break  # no worker extends past i; deeper blocks can't match
+        return OverlapScores({w: s for w, s in scores.items() if s > 0})
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._workers_by_hash)
+
+
+class KvIndexer:
+    """Event-pump wrapper: subscribes to a subject on the event plane and
+    keeps the index current; offers block hashing + match queries."""
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self.index = RadixIndex()
+        self._task: asyncio.Task | None = None
+        self.events_applied = 0
+
+    def block_hashes(self, token_ids: Sequence[int]) -> list[int]:
+        return compute_block_hashes_for_seq(token_ids, self.block_size)
+
+    def find_matches_for_request(self, token_ids: Sequence[int]) -> OverlapScores:
+        return self.index.find_matches(self.block_hashes(token_ids))
+
+    def apply(self, event: RouterEvent) -> None:
+        self.index.apply_event(event)
+        self.events_applied += 1
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.index.remove_worker(worker_id)
+
+    async def start(self, event_plane, subject: str) -> None:
+        if self._task is not None:
+            return
+
+        # Subscribe before the task runs so no event can slip between
+        # start() returning and the pump's first iteration.
+        subscription = event_plane.subscribe(subject)
+
+        async def pump():
+            async for payload in subscription:
+                try:
+                    self.apply(RouterEvent.from_dict(payload))
+                except Exception:
+                    logger.exception("bad kv event: %r", payload)
+
+        self._task = asyncio.create_task(pump(), name=f"kv-indexer[{subject}]")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+
+class KvIndexerSharded:
+    """Shards the index by hash for very large clusters (reference:
+    ``KvIndexerSharded``, indexer.rs:677-790). Queries fan out and merge."""
+
+    def __init__(self, block_size: int, num_shards: int = 4):
+        self.block_size = block_size
+        self.shards = [RadixIndex() for _ in range(num_shards)]
+
+    def _shard(self, worker_id: int) -> RadixIndex:
+        return self.shards[worker_id % len(self.shards)]
+
+    def apply(self, event: RouterEvent) -> None:
+        self._shard(event.worker_id).apply_event(event)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._shard(worker_id).remove_worker(worker_id)
+
+    def find_matches_for_request(self, token_ids: Sequence[int]) -> OverlapScores:
+        hashes = compute_block_hashes_for_seq(token_ids, self.block_size)
+        merged: dict[int, int] = {}
+        for shard in self.shards:
+            merged.update(shard.find_matches(hashes).scores)
+        return OverlapScores(merged)

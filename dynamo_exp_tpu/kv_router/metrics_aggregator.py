@@ -1,0 +1,59 @@
+"""Periodic scrape of worker load metrics into a live snapshot.
+
+Capability parity with
+``/root/reference/lib/llm/src/kv_router/metrics_aggregator.rs:26-110``:
+poll the component's stats plane on an interval, parse
+``ForwardPassMetrics`` per instance, expose the latest
+``ProcessedEndpoints`` plus a change notification.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+from ..runtime.component import Component
+from .protocols import ForwardPassMetrics
+from .scheduler import ProcessedEndpoints
+
+logger = logging.getLogger(__name__)
+
+
+class KvMetricsAggregator:
+    def __init__(self, component: Component, interval_s: float = 0.1):
+        self.component = component
+        self.interval_s = interval_s
+        self.endpoints = ProcessedEndpoints()
+        self.updated = asyncio.Event()
+        self._task: asyncio.Task | None = None
+
+    async def scrape_once(self) -> ProcessedEndpoints:
+        stats = await self.component.scrape_stats()
+        metrics = {
+            wid: ForwardPassMetrics.from_dict(d or {}) for wid, d in stats.items()
+        }
+        self.endpoints = ProcessedEndpoints(metrics=metrics)
+        self.updated.set()
+        return self.endpoints
+
+    async def start(self) -> None:
+        if self._task is not None:
+            return
+
+        async def loop():
+            while True:
+                try:
+                    await self.scrape_once()
+                except Exception:
+                    logger.exception("metrics scrape failed")
+                await asyncio.sleep(self.interval_s)
+
+        self._task = asyncio.create_task(loop(), name="kv-metrics-aggregator")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None

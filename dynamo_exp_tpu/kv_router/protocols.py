@@ -1,0 +1,122 @@
+"""KV-router wire types.
+
+Capability parity with ``/root/reference/lib/llm/src/kv_router/protocols.rs``:
+``ForwardPassMetrics`` (:43-55), ``KvCacheEvent`` Stored/Removed (:79-127),
+``RouterEvent`` envelope, and the router request/response.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Worker load snapshot published via the stats plane."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ForwardPassMetrics":
+        known = {k: d[k] for k in cls().__dict__ if k in d}
+        return cls(**known)
+
+
+@dataclass
+class KvCacheStoredBlock:
+    block_hash: int  # chained sequence hash
+    tokens: list[int] | None = None
+
+
+@dataclass
+class KvCacheEventData:
+    """One stored/removed notification from a worker's page manager."""
+
+    kind: str  # "stored" | "removed"
+    block_hashes: list[int] = field(default_factory=list)
+    parent_hash: int | None = None
+
+
+@dataclass
+class RouterEvent:
+    """Event envelope attributed to a worker (reference: RouterEvent)."""
+
+    worker_id: int
+    data: KvCacheEventData
+
+    def to_dict(self) -> dict:
+        return {
+            "worker_id": self.worker_id,
+            "kind": self.data.kind,
+            "block_hashes": list(self.data.block_hashes),
+            "parent_hash": self.data.parent_hash,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RouterEvent":
+        return cls(
+            worker_id=int(d["worker_id"]),
+            data=KvCacheEventData(
+                kind=d["kind"],
+                block_hashes=[int(h) for h in d.get("block_hashes", [])],
+                parent_hash=d.get("parent_hash"),
+            ),
+        )
+
+
+@dataclass
+class OverlapScores:
+    """find_matches result: per-worker contiguous matched-prefix blocks."""
+
+    scores: dict[int, int] = field(default_factory=dict)
+
+    def best(self) -> int:
+        return max(self.scores.values(), default=0)
+
+
+@dataclass
+class KVHitRateEvent:
+    """Emitted per routing decision (reference: ``scheduler.rs:32``)."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+
+# Event-plane subjects (reference: kv_router.rs:52-53).
+def kv_events_subject(component_path: str) -> str:
+    return f"{component_path}.kv_events"
+
+
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+
+
+@dataclass
+class RouterRequest:
+    token_ids: list[int]
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "RouterRequest":
+        return cls(token_ids=list(d.get("token_ids", [])))
+
+
+@dataclass
+class RouterResponse:
+    worker_id: int
+    overlap_blocks: int = 0
+
+    def to_dict(self) -> dict:
+        return {"worker_id": self.worker_id, "overlap_blocks": self.overlap_blocks}

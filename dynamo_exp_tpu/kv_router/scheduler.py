@@ -1,0 +1,80 @@
+"""KV-aware worker selection.
+
+Capability parity with ``/root/reference/lib/llm/src/kv_router/scheduler.rs``
+(:88-310): pluggable ``WorkerSelector`` over live endpoint metrics +
+overlap scores; the default cost is the reference's
+
+    logit = 2 * overlap_ratio - gpu_cache_usage - normalized_active
+
+with random tie-breaking (scheduler.rs:239-310).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from .protocols import ForwardPassMetrics, OverlapScores
+
+
+@dataclass
+class ProcessedEndpoints:
+    """Live worker set + metrics snapshot (reference: ``scoring.rs:24``)."""
+
+    metrics: dict[int, ForwardPassMetrics] = field(default_factory=dict)
+
+    @property
+    def worker_ids(self) -> list[int]:
+        return list(self.metrics)
+
+
+class WorkerSelector(Protocol):
+    def select_worker(
+        self,
+        endpoints: ProcessedEndpoints,
+        overlaps: OverlapScores,
+        isl_tokens: int,
+        block_size: int,
+    ) -> tuple[int, int]:
+        """Returns (worker_id, overlap_blocks). Raises if no workers."""
+        ...
+
+
+class NoWorkersError(RuntimeError):
+    pass
+
+
+class DefaultWorkerSelector:
+    def __init__(self, rng: random.Random | None = None):
+        self.rng = rng or random.Random()
+
+    def select_worker(
+        self,
+        endpoints: ProcessedEndpoints,
+        overlaps: OverlapScores,
+        isl_tokens: int,
+        block_size: int,
+    ) -> tuple[int, int]:
+        if not endpoints.metrics:
+            raise NoWorkersError("no live workers")
+        best_ids: list[int] = []
+        best_logit = -float("inf")
+        for wid, m in endpoints.metrics.items():
+            matched = overlaps.scores.get(wid, 0)
+            overlap_ratio = (
+                matched * block_size / isl_tokens if isl_tokens > 0 else 0.0
+            )
+            normalized_active = (
+                m.request_active_slots / m.request_total_slots
+                if m.request_total_slots
+                else 0.0
+            )
+            logit = 2.0 * overlap_ratio - m.gpu_cache_usage_perc - normalized_active
+            if logit > best_logit + 1e-12:
+                best_logit = logit
+                best_ids = [wid]
+            elif abs(logit - best_logit) <= 1e-12:
+                best_ids.append(wid)
+        wid = self.rng.choice(best_ids)
+        return wid, overlaps.scores.get(wid, 0)

@@ -34,9 +34,13 @@ Params = dict[str, Any]
 
 
 def _dtype(cfg: ModelConfig):
-    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.bfloat16}[
-        str(cfg.dtype)
-    ]
+    """Param/compute dtype for a config. float16 maps to bfloat16 (the TPU
+    native half type); unknown strings are an error at model-build time."""
+    table = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.bfloat16}
+    try:
+        return table[str(cfg.dtype)]
+    except KeyError:
+        raise ValueError(f"unsupported model dtype: {cfg.dtype!r}") from None
 
 
 def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:

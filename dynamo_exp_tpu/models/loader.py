@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import ModelConfig
-from .llama import Params
+from .llama import Params, _dtype
 
 
 def _open_safetensors(path: str):
@@ -42,7 +42,7 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
     if cfg is None:
         cfg = ModelConfig.from_pretrained(path)
     handles, index = _open_safetensors(path)
-    dt = jnp.bfloat16 if cfg.dtype != "float32" else jnp.float32
+    dt = _dtype(cfg)
 
     def get(name: str) -> np.ndarray:
         arr = handles[index[name]].get_tensor(name)

@@ -20,6 +20,7 @@ from .runtime import Runtime
 from .transports.base import (
     Discovery,
     EndpointAddress,
+    EventPlane,
     Handler,
     InstanceInfo,
     Lease,
@@ -42,6 +43,7 @@ class DistributedRuntime:
         config: RuntimeConfig | None = None,
         discovery: Discovery | None = None,
         request_plane: RequestPlane | None = None,
+        event_plane: "EventPlane | None" = None,
     ):
         self.config = config or RuntimeConfig()
         self.runtime = runtime or Runtime(
@@ -71,6 +73,11 @@ class DistributedRuntime:
                 )
         self.discovery = discovery
         self.request_plane = request_plane
+        if event_plane is None:
+            from .transports.inproc import InProcEventPlane
+
+            event_plane = InProcEventPlane()
+        self.event_plane = event_plane
         self._namespaces: dict[str, Namespace] = {}
         self._primary_lease: Lease | None = None
 
@@ -102,6 +109,7 @@ class DistributedRuntime:
         if self._primary_lease is not None and self._primary_lease.is_valid():
             await self._primary_lease.revoke()
         await self.request_plane.close()
+        await self.event_plane.close()
         await self.discovery.close()
         await self.runtime.close()
 

@@ -176,4 +176,20 @@ class RequestPlane(abc.ABC):
         return None
 
 
+class EventPlane(abc.ABC):
+    """Fire-and-forget pub/sub by subject — the NATS-subject equivalent
+    (reference publishes KV events on ``{component}.kv_events``,
+    ``/root/reference/lib/llm/src/kv_router/kv_router.rs:52``)."""
+
+    @abc.abstractmethod
+    async def publish(self, subject: str, payload: dict) -> None: ...
+
+    @abc.abstractmethod
+    def subscribe(self, subject: str) -> "AsyncIterator[dict]":
+        """Yields payloads published to ``subject`` after subscription."""
+
+    async def close(self) -> None:  # pragma: no cover - default no-op
+        return None
+
+
 RequestHook = Callable[[dict], Awaitable[None]]

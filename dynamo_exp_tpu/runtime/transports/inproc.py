@@ -22,6 +22,7 @@ from typing import AsyncIterator
 from ..engine import AsyncEngineContext
 from .base import (
     Discovery,
+    EventPlane,
     Handler,
     InstanceInfo,
     Lease,
@@ -241,3 +242,38 @@ class InProcRequestPlane(RequestPlane):
         if stats_handler is not None:
             stats.update(stats_handler())
         return stats
+
+
+class InProcEventPlane(EventPlane):
+    """Subject-based fan-out pub/sub inside one process. Subjects support
+    a trailing ``*`` wildcard on subscribe (``ns.comp.*``)."""
+
+    def __init__(self, latency: LatencyModel | None = None):
+        self.latency = latency or LatencyModel()
+        self._subs: dict[str, list[asyncio.Queue]] = {}
+
+    async def publish(self, subject: str, payload: dict) -> None:
+        await self.latency.delay()
+        for pattern, queues in list(self._subs.items()):
+            if pattern == subject or (
+                pattern.endswith("*") and subject.startswith(pattern[:-1])
+            ):
+                for q in queues:
+                    q.put_nowait(payload)
+
+    def subscribe(self, subject: str) -> AsyncIterator[dict]:
+        # Register the queue eagerly (not at first iteration) so events
+        # published between subscribe() and the consumer's first await are
+        # not lost.
+        q: asyncio.Queue = asyncio.Queue()
+        self._subs.setdefault(subject, []).append(q)
+
+        async def _gen() -> AsyncIterator[dict]:
+            try:
+                while True:
+                    yield await q.get()
+            finally:
+                with contextlib.suppress(ValueError):
+                    self._subs.get(subject, []).remove(q)
+
+        return _gen()

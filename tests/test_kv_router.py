@@ -1,0 +1,171 @@
+"""KV-aware router: index correctness, selection policy, and end-to-end
+routing over the in-proc runtime with engine-published events."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.kv_router import (
+    DefaultWorkerSelector,
+    ForwardPassMetrics,
+    KvCacheEventData,
+    KvEventPublisher,
+    KvIndexer,
+    KvRouter,
+    NoWorkersError,
+    OverlapScores,
+    ProcessedEndpoints,
+    RadixIndex,
+    RouterEvent,
+)
+from dynamo_exp_tpu.runtime.component import DistributedRuntime
+from dynamo_exp_tpu.tokens import compute_block_hashes_for_seq
+
+
+def ev(worker, kind, hashes, parent=None):
+    return RouterEvent(worker, KvCacheEventData(kind, list(hashes), parent))
+
+
+def test_radix_index_contiguous_prefix_matching():
+    idx = RadixIndex()
+    toks = list(range(1, 33))
+    hashes = compute_block_hashes_for_seq(toks, 8)  # 4 blocks
+    idx.apply_event(ev(1, "stored", hashes[:3]))
+    idx.apply_event(ev(2, "stored", hashes[:1]))
+    # Worker 3 holds blocks 2-3 but NOT the start: must score 0.
+    idx.apply_event(ev(3, "stored", hashes[2:]))
+
+    scores = idx.find_matches(hashes).scores
+    assert scores == {1: 3, 2: 1}
+
+    idx.apply_event(ev(1, "removed", [hashes[1]]))
+    assert idx.find_matches(hashes).scores == {1: 1, 2: 1}
+
+    idx.remove_worker(1)
+    assert idx.find_matches(hashes).scores == {2: 1}
+
+
+def test_selector_prefers_overlap_then_load():
+    sel = DefaultWorkerSelector()
+    eps = ProcessedEndpoints(
+        metrics={
+            1: ForwardPassMetrics(request_active_slots=0, request_total_slots=8),
+            2: ForwardPassMetrics(request_active_slots=0, request_total_slots=8),
+        }
+    )
+    # Worker 2 has 4 of 8 blocks cached (isl 64, bs 8): overlap wins.
+    wid, overlap = sel.select_worker(eps, OverlapScores({2: 4}), 64, 8)
+    assert (wid, overlap) == (2, 4)
+
+    # Same overlap, worker 1 heavily loaded -> worker 2.
+    eps.metrics[1].request_active_slots = 8
+    eps.metrics[1].gpu_cache_usage_perc = 0.9
+    wid, _ = sel.select_worker(eps, OverlapScores({1: 2, 2: 2}), 64, 8)
+    assert wid == 2
+
+    # Big overlap beats moderate load difference (2*overlap term).
+    eps2 = ProcessedEndpoints(
+        metrics={
+            1: ForwardPassMetrics(
+                request_active_slots=4, request_total_slots=8,
+                gpu_cache_usage_perc=0.5,
+            ),
+            2: ForwardPassMetrics(request_active_slots=0, request_total_slots=8),
+        }
+    )
+    wid, _ = sel.select_worker(eps2, OverlapScores({1: 8}), 64, 8)
+    assert wid == 1  # 2*1.0 - 0.5 - 0.5 = 1.0 > 0.0
+
+    with pytest.raises(NoWorkersError):
+        sel.select_worker(ProcessedEndpoints(), OverlapScores(), 10, 8)
+
+
+async def test_kv_router_end_to_end_over_runtime():
+    """Two fake workers serve via the in-proc runtime; KV events flow over
+    the event plane; the router sends a warm request to the cache holder."""
+    drt = DistributedRuntime.detached()
+    comp = drt.namespace("test").component("backend")
+
+    stats = {
+        "w1": ForwardPassMetrics(request_total_slots=8),
+        "w2": ForwardPassMetrics(request_total_slots=8),
+    }
+
+    async def handler(request, ctx):
+        yield {"ok": True}
+
+    i1 = await comp.endpoint("generate").serve_endpoint(
+        handler, stats_handler=lambda: stats["w1"].to_dict()
+    )
+    i2 = await comp.endpoint("generate").serve_endpoint(
+        handler, stats_handler=lambda: stats["w2"].to_dict()
+    )
+
+    router = KvRouter(comp, block_size=8, scrape_interval_s=0.01)
+    await router.start()
+
+    toks = list(np.random.RandomState(0).randint(1, 100, size=32))
+    hashes = compute_block_hashes_for_seq(toks, 8)
+
+    pub1 = KvEventPublisher(
+        drt.event_plane, comp.path, worker_id=i1.instance_id,
+        loop=asyncio.get_running_loop(),
+    )
+    await pub1.publish(KvCacheEventData("stored", hashes))
+    await asyncio.sleep(0.05)  # let the indexer pump apply it
+
+    resp = await router.schedule(toks)
+    assert resp.worker_id == i1.instance_id
+    assert resp.overlap_blocks == 4
+
+    # Cold request (no overlap anywhere): both workers equally idle —
+    # any choice is fine; with w1 loaded it must pick w2.
+    stats["w1"].request_active_slots = 8
+    await asyncio.sleep(0.05)  # aggregator picks up the new stats
+    cold = list(np.random.RandomState(9).randint(100, 200, size=32))
+    resp2 = await router.schedule(cold)
+    assert resp2.worker_id == i2.instance_id
+
+    await router.stop()
+    await i1.close()
+    await i2.close()
+    await drt.close()
+
+
+async def test_engine_events_reach_router_index():
+    """Real tiny engine -> KvEventPublisher -> event plane -> KvIndexer."""
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models import TINY
+    from dynamo_exp_tpu.parallel import single_device_mesh
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    drt = DistributedRuntime.detached()
+    pub = KvEventPublisher(
+        drt.event_plane, "test.backend", worker_id=7,
+        loop=asyncio.get_running_loop(),
+    )
+    indexer = KvIndexer(block_size=8)
+    await indexer.start(drt.event_plane, "test.backend.kv_events")
+
+    cfg = EngineConfig(
+        model=TINY, max_decode_slots=2, page_size=8, num_pages=32,
+        max_model_len=64, eos_token_ids=[],
+    )
+    eng = TPUEngine(cfg, mesh=single_device_mesh(), kv_event_cb=pub.engine_callback())
+    eng.start()
+    try:
+        prompt = list(np.random.RandomState(3).randint(3, 200, size=17))
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = 4
+        b.stop_conditions.ignore_eos = True
+        stream = await eng.generate(b.to_dict())
+        async for _ in stream:
+            pass
+        await asyncio.sleep(0.1)
+        scores = indexer.find_matches_for_request(prompt)
+        assert scores.scores.get(7, 0) >= 2  # both full prompt pages indexed
+    finally:
+        eng.stop()
+        await indexer.stop()
+        await drt.close()
