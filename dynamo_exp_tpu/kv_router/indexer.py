@@ -105,9 +105,10 @@ class KvIndexer:
         if self._task is not None:
             return
 
-        # Subscribe before the task runs so no event can slip between
-        # start() returning and the pump's first iteration.
-        subscription = event_plane.subscribe(subject)
+        # Subscribe (fully registered on return) before the task runs so no
+        # event can slip between start() returning and the pump's first
+        # iteration.
+        subscription = await event_plane.subscribe(subject)
 
         async def pump():
             async for payload in subscription:

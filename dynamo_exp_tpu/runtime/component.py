@@ -24,9 +24,11 @@ from .transports.base import (
     Handler,
     InstanceInfo,
     Lease,
+    ObjectStore,
     RequestPlane,
     ServedEndpoint,
     StatsHandler,
+    WorkQueue,
 )
 from .transports.inproc import InProcDiscovery, InProcRequestPlane, next_instance_id
 
@@ -54,14 +56,8 @@ class DistributedRuntime:
                 discovery = discovery or InProcDiscovery()
                 request_plane = request_plane or InProcRequestPlane()
             else:
-                try:
-                    from .transports.coordinator import CoordinatorDiscovery
-                    from .transports.tcp import TcpRequestPlane
-                except ImportError as e:  # pragma: no cover
-                    raise NotImplementedError(
-                        "dynamic mode requires the coordinator/tcp transports; "
-                        f"this build is missing them: {e}"
-                    ) from e
+                from .transports.coordinator import CoordinatorDiscovery
+                from .transports.tcp import TcpRequestPlane
 
                 discovery = discovery or CoordinatorDiscovery(
                     self.config.coordinator_endpoint,
@@ -73,11 +69,9 @@ class DistributedRuntime:
                 )
         self.discovery = discovery
         self.request_plane = request_plane
-        if event_plane is None:
-            from .transports.inproc import InProcEventPlane
-
-            event_plane = InProcEventPlane()
-        self.event_plane = event_plane
+        # The discovery backend is the factory for its sibling planes, so
+        # events/queues/blobs automatically ride the same fabric.
+        self.event_plane = event_plane or self.discovery.event_plane()
         self._namespaces: dict[str, Namespace] = {}
         self._primary_lease: Lease | None = None
 
@@ -101,6 +95,15 @@ class DistributedRuntime:
         if name not in self._namespaces:
             self._namespaces[name] = Namespace(self, name)
         return self._namespaces[name]
+
+    def work_queue(self, name: str) -> "WorkQueue":
+        """A named FIFO work queue (JetStream work-queue equivalent)."""
+        return self.discovery.work_queue(name)
+
+    @property
+    def object_store(self) -> "ObjectStore":
+        """Bucketed blob store (NATS object-store equivalent, holds MDCs)."""
+        return self.discovery.object_store()
 
     def shutdown(self) -> None:
         self.runtime.shutdown()

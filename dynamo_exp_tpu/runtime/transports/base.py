@@ -139,6 +139,46 @@ class Discovery(abc.ABC):
         """Yields the full prefix snapshot on every change (first yield is
         the current snapshot)."""
 
+    # --- sibling-plane factories ---
+    # The discovery backend knows which fabric the process is on, so it is
+    # the factory for the other control-plane services (events, queues,
+    # object store). Defaults are in-process; coordinator-backed discovery
+    # overrides them to ride the same server connection.
+    def _new_event_plane(self) -> "EventPlane":
+        from .inproc import InProcEventPlane
+
+        return InProcEventPlane()
+
+    def _new_work_queue(self, name: str) -> "WorkQueue":
+        from .inproc import InProcWorkQueue
+
+        return InProcWorkQueue()
+
+    def _new_object_store(self) -> "ObjectStore":
+        from .inproc import InProcObjectStore
+
+        return InProcObjectStore()
+
+    def event_plane(self) -> "EventPlane":
+        plane = getattr(self, "_event_plane", None)
+        if plane is None:
+            plane = self._event_plane = self._new_event_plane()
+        return plane
+
+    def work_queue(self, name: str) -> "WorkQueue":
+        queues = getattr(self, "_work_queues", None)
+        if queues is None:
+            queues = self._work_queues = {}
+        if name not in queues:
+            queues[name] = self._new_work_queue(name)
+        return queues[name]
+
+    def object_store(self) -> "ObjectStore":
+        store = getattr(self, "_object_store", None)
+        if store is None:
+            store = self._object_store = self._new_object_store()
+        return store
+
     async def close(self) -> None:  # pragma: no cover - default no-op
         return None
 
@@ -185,11 +225,47 @@ class EventPlane(abc.ABC):
     async def publish(self, subject: str, payload: dict) -> None: ...
 
     @abc.abstractmethod
-    def subscribe(self, subject: str) -> "AsyncIterator[dict]":
-        """Yields payloads published to ``subject`` after subscription."""
+    async def subscribe(self, subject: str) -> "AsyncIterator[dict]":
+        """Returns a stream of payloads published to ``subject``. The
+        subscription is fully registered before this returns: no event
+        published afterwards can be missed."""
 
     async def close(self) -> None:  # pragma: no cover - default no-op
         return None
+
+
+class WorkQueue(abc.ABC):
+    """Durable-ish FIFO work queue — the JetStream work-queue equivalent
+    the reference uses as its prefill queue
+    (``/root/reference/examples/llm/utils/nats_queue.py:1-159``)."""
+
+    @abc.abstractmethod
+    async def push(self, payload: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def pull(self, timeout_s: float | None = None) -> bytes | None:
+        """Pop the oldest item; blocks up to ``timeout_s`` (None = forever),
+        returns None on timeout."""
+
+    @abc.abstractmethod
+    async def size(self) -> int: ...
+
+
+class ObjectStore(abc.ABC):
+    """Bucketed blob store — the NATS object-store equivalent used for
+    ModelDeploymentCards (``/root/reference/lib/runtime/src/transports/nats.rs:123``)."""
+
+    @abc.abstractmethod
+    async def put(self, bucket: str, key: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    async def get(self, bucket: str, key: str) -> bytes | None: ...
+
+    @abc.abstractmethod
+    async def delete(self, bucket: str, key: str) -> None: ...
+
+    @abc.abstractmethod
+    async def list(self, bucket: str) -> list[str]: ...
 
 
 RequestHook = Callable[[dict], Awaitable[None]]

@@ -26,9 +26,11 @@ from .base import (
     Handler,
     InstanceInfo,
     Lease,
+    ObjectStore,
     RequestPlane,
     ServedEndpoint,
     StatsHandler,
+    WorkQueue,
 )
 
 _instance_ids = itertools.count(1)
@@ -261,10 +263,9 @@ class InProcEventPlane(EventPlane):
                 for q in queues:
                     q.put_nowait(payload)
 
-    def subscribe(self, subject: str) -> AsyncIterator[dict]:
-        # Register the queue eagerly (not at first iteration) so events
-        # published between subscribe() and the consumer's first await are
-        # not lost.
+    async def subscribe(self, subject: str) -> AsyncIterator[dict]:
+        # Register the queue before returning so events published between
+        # subscribe() and the consumer's first await are not lost.
         q: asyncio.Queue = asyncio.Queue()
         self._subs.setdefault(subject, []).append(q)
 
@@ -277,3 +278,41 @@ class InProcEventPlane(EventPlane):
                     self._subs.get(subject, []).remove(q)
 
         return _gen()
+
+
+class InProcWorkQueue(WorkQueue):
+    """FIFO queue in process memory (static-mode prefill queue)."""
+
+    def __init__(self):
+        self._q: asyncio.Queue[bytes] = asyncio.Queue()
+
+    async def push(self, payload: bytes) -> None:
+        self._q.put_nowait(payload)
+
+    async def pull(self, timeout_s: float | None = None) -> bytes | None:
+        try:
+            if timeout_s is None:
+                return await self._q.get()
+            return await asyncio.wait_for(self._q.get(), timeout_s)
+        except asyncio.TimeoutError:
+            return None
+
+    async def size(self) -> int:
+        return self._q.qsize()
+
+
+class InProcObjectStore(ObjectStore):
+    def __init__(self):
+        self._buckets: dict[str, dict[str, bytes]] = {}
+
+    async def put(self, bucket: str, key: str, data: bytes) -> None:
+        self._buckets.setdefault(bucket, {})[key] = data
+
+    async def get(self, bucket: str, key: str) -> bytes | None:
+        return self._buckets.get(bucket, {}).get(key)
+
+    async def delete(self, bucket: str, key: str) -> None:
+        self._buckets.get(bucket, {}).pop(key, None)
+
+    async def list(self, bucket: str) -> list[str]:
+        return sorted(self._buckets.get(bucket, {}))

@@ -1,0 +1,26 @@
+"""Subprocess worker for multi-process transport tests: serves an echo
+endpoint over the coordinator + TCP planes, then blocks until killed."""
+
+import asyncio
+import sys
+
+from dynamo_exp_tpu.runtime import Annotated, DistributedRuntime
+from dynamo_exp_tpu.runtime.config import RuntimeConfig
+
+
+async def echo_handler(request, context):
+    for tok in request["tokens"]:
+        yield Annotated.from_data({"token": tok}).to_dict()
+
+
+async def main(coordinator_address: str) -> None:
+    cfg = RuntimeConfig(coordinator_endpoint=coordinator_address, lease_ttl_s=2.0)
+    drt = DistributedRuntime(config=cfg)
+    ep = drt.namespace("mp").component("worker").endpoint("generate")
+    await ep.serve_endpoint(echo_handler)
+    print("worker ready", flush=True)
+    await asyncio.Event().wait()
+
+
+if __name__ == "__main__":
+    asyncio.run(main(sys.argv[1]))
