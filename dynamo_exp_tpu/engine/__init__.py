@@ -1,6 +1,7 @@
 from .config import EngineConfig
 from .engine import TPUEngine
 from .kv_manager import KvEvent, KvPageManager
+from .offload import CopyStream, HostKvPool
 from .scheduler import Scheduler, Sequence
 
 __all__ = [
@@ -8,6 +9,8 @@ __all__ = [
     "TPUEngine",
     "KvPageManager",
     "KvEvent",
+    "HostKvPool",
+    "CopyStream",
     "Scheduler",
     "Sequence",
 ]

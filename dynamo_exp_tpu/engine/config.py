@@ -39,6 +39,10 @@ class EngineConfig:
     eos_token_ids: list[int] = field(default_factory=list)
     # KV cache dtype ("bfloat16" | "float32").
     kv_dtype: str = "bfloat16"
+    # G2 host-RAM KV tier: number of host pages (0 disables offload).
+    # Device-evicted pages spill here and are re-injected on prefix match
+    # instead of being recomputed (reference: kv/manager.rs G1/G2 tiers).
+    host_cache_pages: int = 0
     # Emit KV stored/removed events for the router index.
     enable_kv_events: bool = True
 
@@ -46,6 +50,16 @@ class EngineConfig:
         if not self.prefill_buckets:
             self.prefill_buckets = default_prefill_buckets(self.max_model_len)
         self.prefill_buckets = sorted(set(self.prefill_buckets))
+        if self.kv_dtype not in ("bfloat16", "float32"):
+            raise ValueError(f"unsupported kv_dtype: {self.kv_dtype!r}")
+
+    @property
+    def kv_dtype_jnp(self):
+        """Single source of truth for the KV dtype (device pool, host
+        pool, and every offload round-trip must agree bit-for-bit)."""
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.kv_dtype == "bfloat16" else jnp.float32
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -54,6 +54,7 @@ from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from .config import EngineConfig
 from .kv_manager import KvEvent, KvPageManager
+from .offload import CopyStream, HostKvPool
 from .scheduler import Scheduler, SeqState, Sequence
 
 log = logging.getLogger(__name__)
@@ -87,16 +88,50 @@ class TPUEngine(AsyncEngine):
                 is_leaf=lambda x: isinstance(x, P),
             ),
         )
-        kv_dtype = jnp.bfloat16 if cfg.kv_dtype == "bfloat16" else jnp.float32
         kspec, vspec = kv_cache_shardings()
-        k, v = init_kv_cache(mcfg, cfg.num_pages, cfg.page_size, dtype=kv_dtype)
+        k, v = init_kv_cache(
+            mcfg, cfg.num_pages, cfg.page_size, dtype=cfg.kv_dtype_jnp
+        )
         self.k_cache = jax.device_put(k, sharding(kspec))
         self.v_cache = jax.device_put(v, sharding(vspec))
+
+        self.host_pool: HostKvPool | None = None
+        self.copy_stream: CopyStream | None = None
+        on_evict = None
+        if cfg.host_cache_pages > 0:
+            page_shape = (
+                mcfg.num_layers,
+                cfg.page_size,
+                mcfg.num_kv_heads,
+                mcfg.head_dim_,
+            )
+            self.host_pool = HostKvPool(
+                cfg.host_cache_pages, page_shape, cfg.kv_dtype_jnp
+            )
+            # The CopyStream (a live thread) is created by start(), so a
+            # constructed-but-never-started engine owns no threads.
+            self._gather_page = jax.jit(lambda k, v, pid: (k[:, pid], v[:, pid]))
+            self._inject_page = jax.jit(
+                lambda k, v, pid, hk, hv: (
+                    k.at[:, pid].set(hk),
+                    v.at[:, pid].set(hv),
+                ),
+                donate_argnums=(0, 1),
+            )
+
+            def on_evict(pid: int, seq_hash: int) -> None:
+                # Dispatch the on-device gather now (stream order protects
+                # it from the next donated forward); the CopyStream thread
+                # blocks on the transfer and commits into the host pool.
+                k_pg, v_pg = self._gather_page(self.k_cache, self.v_cache, pid)
+                self.copy_stream.offload(seq_hash, k_pg, v_pg)
 
         self.kv = KvPageManager(
             cfg.num_pages,
             cfg.page_size,
             event_cb=kv_event_cb if cfg.enable_kv_events else None,
+            host_pool=self.host_pool,
+            on_evict=on_evict,
         )
         self.sched = Scheduler(cfg, self.kv)
 
@@ -157,6 +192,10 @@ class TPUEngine(AsyncEngine):
     def start(self) -> None:
         if self._running:
             return
+        if self.host_pool is not None and self.copy_stream is None:
+            # stop() tears the copy stream down; a restarted engine needs
+            # a live one before the first eviction fires on_evict.
+            self.copy_stream = CopyStream(self.host_pool)
         self._running = True
         self._thread = threading.Thread(
             target=self._loop, name="tpu-engine-loop", daemon=True
@@ -169,6 +208,9 @@ class TPUEngine(AsyncEngine):
         if self._thread:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.copy_stream is not None:
+            self.copy_stream.stop()
+            self.copy_stream = None
 
     # ------------------------------------------------------------ AsyncEngine
     async def generate(
@@ -265,6 +307,15 @@ class TPUEngine(AsyncEngine):
     # ---------------------------------------------------------------- prefill
     def _run_prefill(self, seq: Sequence) -> None:
         cfg = self.cfg
+        if seq.pending_uploads:
+            # Re-inject G2 host pages into their fresh device pages before
+            # the prefill that attends over them (dispatch order on the
+            # device stream makes this safe without explicit sync).
+            for pid, _h, hk, hv in seq.pending_uploads:
+                self.k_cache, self.v_cache = self._inject_page(
+                    self.k_cache, self.v_cache, pid, jnp.asarray(hk), jnp.asarray(hv)
+                )
+            seq.pending_uploads = []
         suffix = seq.prompt[seq.cached_len :]
         bucket = cfg.bucket_for(len(suffix))
         tokens = np.zeros((1, bucket), np.int32)
@@ -370,4 +421,9 @@ class TPUEngine(AsyncEngine):
 
     # --------------------------------------------------------------- metrics
     def metrics(self) -> dict:
-        return self.sched.metrics()
+        m = self.sched.metrics()
+        if self.host_pool is not None:
+            m["host_cache_resident"] = self.host_pool.resident
+            m["host_cache_hits"] = self.host_pool.hits
+            m["host_cache_stores"] = self.host_pool.stores
+        return m

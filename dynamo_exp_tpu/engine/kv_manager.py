@@ -24,9 +24,14 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Callable, Sequence
+from typing import TYPE_CHECKING, Callable, Sequence
 
 from ..tokens import compute_block_hashes_for_seq
+
+if TYPE_CHECKING:
+    import numpy as np
+
+    from .offload import HostKvPool
 
 
 @dataclass
@@ -34,6 +39,23 @@ class PageRecord:
     page_id: int
     seq_hash: int | None = None  # None until the page is full + registered
     ref_count: int = 0
+
+
+@dataclass
+class Allocation:
+    """Result of ``allocate_sequence``.
+
+    ``page_ids`` covers ceil(len(tokens)/page_size) pages; ``cached_len``
+    (a multiple of page_size) counts G1-matched plus G2-uploaded pages;
+    ``uploads`` lists (page_id, seq_hash, k_page, v_page) host pages the
+    engine must inject before prefill; ``hashes`` are the chained
+    sequence hashes of every full prompt page (computed once here so the
+    scheduler never rehashes the prompt)."""
+
+    page_ids: list[int]
+    cached_len: int
+    uploads: list
+    hashes: list[int]
 
 
 @dataclass
@@ -59,10 +81,17 @@ class KvPageManager:
         num_pages: int,
         page_size: int,
         event_cb: Callable[[KvEvent], None] | None = None,
+        host_pool: "HostKvPool | None" = None,
+        on_evict: Callable[[int, int], None] | None = None,
     ):
         self.num_pages = num_pages
         self.page_size = page_size
         self.event_cb = event_cb
+        # G2 tier: evicted device pages are offloaded (via ``on_evict``,
+        # which the engine wires to a device gather + CopyStream) and
+        # matched back in from ``host_pool`` on later prompts.
+        self.host_pool = host_pool
+        self.on_evict = on_evict
         self._records: dict[int, PageRecord] = {
             i: PageRecord(i) for i in range(num_pages)
         }
@@ -99,7 +128,11 @@ class KvPageManager:
         Returns (page_ids, seq_hashes) of the matched prefix — does NOT
         take references; call ``allocate_sequence`` to commit.
         """
-        hashes = compute_block_hashes_for_seq(tokens, self.page_size)
+        return self._match_hashes(
+            compute_block_hashes_for_seq(tokens, self.page_size)
+        )
+
+    def _match_hashes(self, hashes: list[int]) -> tuple[list[int], list[int]]:
         pages: list[int] = []
         matched: list[int] = []
         for h in hashes:
@@ -112,34 +145,64 @@ class KvPageManager:
 
     def allocate_sequence(
         self, tokens: Sequence[int], max_pages: int
-    ) -> tuple[list[int], int] | None:
-        """Pages for a new sequence: reuse the longest cached prefix, then
-        fresh pages for the rest of the prompt.
+    ) -> Allocation | None:
+        """Pages for a new sequence: reuse the longest device-resident
+        (G1) prefix, extend it from the host tier (G2), then fresh pages
+        for the rest of the prompt.
 
-        Returns (page_ids, cached_len) or None if the pool can't satisfy
-        the request right now (caller re-queues).
-        ``page_ids`` covers ceil(len(tokens)/ps) pages; the trailing
-        partial page is fresh. cached_len is a multiple of page_size.
+        Returns an ``Allocation`` or None if the pool can't satisfy the
+        request right now (caller re-queues).
         """
         ps = self.page_size
         need_total = (len(tokens) + ps - 1) // ps
         if need_total > max_pages:
             return None  # exceeds per-sequence capacity; caller must reject
-        matched_pages, matched_hashes = self.match_prefix(tokens)
+        hashes = compute_block_hashes_for_seq(tokens, ps)
+        matched_pages, matched_hashes = self._match_hashes(hashes)
+        # Extend the match into the host tier — match first (no copies);
+        # pages are fetched only once the allocation is known to succeed,
+        # so a pool-exhausted retry loop never repeats the memcpys.
+        g2_hashes: list[int] = []
+        if self.host_pool is not None:
+            g2_hashes = self.host_pool.match_chain(hashes[len(matched_pages) :])
         # Never reuse the *entire* prompt: the last token's KV must be
         # recomputed into a page this sequence owns so decode can append.
-        while matched_pages and len(matched_pages) * ps >= len(tokens):
-            matched_pages.pop()
-            matched_hashes.pop()
+        while (
+            matched_pages or g2_hashes
+        ) and (len(matched_pages) + len(g2_hashes)) * ps >= len(tokens):
+            if g2_hashes:
+                g2_hashes.pop()
+            else:
+                matched_pages.pop()
+                matched_hashes.pop()
         need_fresh = need_total - len(matched_pages)
-        if need_fresh > self._available_for_take():
+        # Matched parked pages are about to leave the reclaimable LRU
+        # (_ref_page below); counting them as takeable here would let
+        # _take_free pop an empty LRU and crash the engine loop.
+        parked_matches = sum(
+            1 for pid in matched_pages if self._records[pid].ref_count == 0
+        )
+        if need_fresh > self._available_for_take() - parked_matches:
             return None
+        # fetch() copies each page out under the pool lock, so a
+        # concurrent LRU eviction can't corrupt it before injection; a
+        # miss (evicted since match) just shortens the restored prefix.
+        host_pages: list[tuple[int, "np.ndarray", "np.ndarray"]] = []
+        for h in g2_hashes:
+            data = self.host_pool.fetch(h)
+            if data is None:
+                break
+            host_pages.append((h, data[0], data[1]))
         for pid in matched_pages:  # commit the reuse
             self._ref_page(pid)
         fresh = [self._take_free() for _ in range(need_fresh)]
-        self.hits += len(matched_pages)
-        self.misses += need_fresh
-        return matched_pages + fresh, len(matched_pages) * ps
+        uploads = [
+            (fresh[j], h, k, v) for j, (h, k, v) in enumerate(host_pages)
+        ]
+        self.hits += len(matched_pages) + len(host_pages)
+        self.misses += need_fresh - len(host_pages)
+        cached = (len(matched_pages) + len(host_pages)) * ps
+        return Allocation(matched_pages + fresh, cached, uploads, hashes)
 
     def allocate_page(self) -> int | None:
         """One fresh page (decode crossing a page boundary)."""
@@ -214,6 +277,11 @@ class KvPageManager:
     def _evict(self, pid: int) -> None:
         rec = self._records[pid]
         if rec.seq_hash is not None:
+            if self.on_evict is not None:
+                # Offload to G2 before the page can be overwritten: the
+                # engine dispatches the on-device gather synchronously
+                # here (stream order protects it from the next forward).
+                self.on_evict(pid, rec.seq_hash)
             self._by_hash.pop(rec.seq_hash, None)
             if self.event_cb:
                 self.event_cb(KvEvent("removed", [rec.seq_hash]))

@@ -1,0 +1,166 @@
+"""G2 host-RAM KV tier + async device↔host copy stream.
+
+Capability parity with the reference's two-tier KV storage manager
+(``/root/reference/lib/llm/src/kv/manager.rs:22-168`` — G1 device / G2
+host — and the ``CopyStream`` batched async block copies in
+``kv/layer.rs:619-2066`` backed by ``kernels/block_copy.cu``), redesigned
+for TPU:
+
+- The host tier is one preallocated numpy pool per K/V (the reference
+  uses pinned host memory via ``cuda_malloc_host``; on TPU-VM plain
+  numpy is already in host RAM and ``jax.device_put`` DMAs from it).
+- Device→host movement = a jitted per-page gather (XLA dynamic-slice on
+  the page axis) dispatched on the engine loop thread, then materialized
+  (``np.asarray``) on a background copy thread so eviction never blocks
+  the decode loop. Dispatch-order semantics guarantee the gather reads
+  the page before any later donated forward overwrites it.
+- Host→device movement = a jitted scatter (``.at[:, pid].set``) of the
+  host page into a freshly allocated device page, dispatched before the
+  prefill that consumes it.
+
+Pages are keyed by the same chained sequence hash used for G1 prefix
+reuse and router events (``tokens.py``), so the three tiers (device,
+host, remote-worker-via-router) share one content-addressing scheme.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+class HostKvPool:
+    """Fixed-capacity host-RAM page pool, content-addressed, LRU-evicted.
+
+    Thread-safe: written by the copy thread, read (matched/fetched) by
+    the engine loop thread.
+    """
+
+    def __init__(self, num_pages: int, page_shape: tuple[int, ...], dtype):
+        self.num_pages = num_pages
+        self._k = np.zeros((num_pages,) + page_shape, dtype)
+        self._v = np.zeros((num_pages,) + page_shape, dtype)
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+        # seq_hash -> host slot; OrderedDict doubles as the LRU (oldest first).
+        self._by_hash: OrderedDict[int, int] = OrderedDict()
+        self._lock = threading.Lock()
+        # Metrics.
+        self.stores = 0
+        self.hits = 0
+        self.evictions = 0
+
+    def __contains__(self, seq_hash: int) -> bool:
+        with self._lock:
+            return seq_hash in self._by_hash
+
+    @property
+    def resident(self) -> int:
+        with self._lock:
+            return len(self._by_hash)
+
+    def store(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray) -> None:
+        """Insert one page; evicts the LRU page when full. Idempotent per
+        hash (a page already resident is refreshed, not duplicated)."""
+        with self._lock:
+            slot = self._by_hash.get(seq_hash)
+            if slot is None:
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    _, slot = self._by_hash.popitem(last=False)
+                    self.evictions += 1
+                self._by_hash[seq_hash] = slot
+            self._by_hash.move_to_end(seq_hash)
+            self._k[slot] = k_page
+            self._v[slot] = v_page
+            self.stores += 1
+
+    def fetch(self, seq_hash: int) -> tuple[np.ndarray, np.ndarray] | None:
+        """Copy one page out (the copy pins the content against a
+        concurrent LRU eviction overwriting the slot)."""
+        with self._lock:
+            slot = self._by_hash.get(seq_hash)
+            if slot is None:
+                return None
+            self._by_hash.move_to_end(seq_hash)
+            self.hits += 1
+            return self._k[slot].copy(), self._v[slot].copy()
+
+    def match_chain(self, seq_hashes: list[int]) -> list[int]:
+        """Longest resident prefix of the hash chain (for extending a G1
+        match into G2 without fetching yet)."""
+        out: list[int] = []
+        with self._lock:
+            for h in seq_hashes:
+                if h not in self._by_hash:
+                    break
+                out.append(h)
+        return out
+
+
+class CopyStream:
+    """Background device→host materializer.
+
+    The engine loop dispatches the on-device page gather (cheap, async)
+    and hands the resulting device arrays here; this thread blocks on the
+    transfer (``np.asarray``) and commits the page into the host pool —
+    the TPU analogue of the reference's CUDA ``CopyStream`` with
+    completion events (``kv/layer.rs:619+``).
+    """
+
+    def __init__(self, pool: HostKvPool, max_inflight: int = 256):
+        self.pool = pool
+        # Bounded: each entry pins a gathered K/V device-array pair, so a
+        # burst of evictions outpacing the blocking host transfers must
+        # shed load (the tier is a cache — dropping an offload only costs
+        # a future recompute) instead of growing HBM pressure unboundedly.
+        self._q: queue.Queue = queue.Queue(maxsize=max_inflight)
+        self._thread = threading.Thread(
+            target=self._run, name="kv-copy-stream", daemon=True
+        )
+        self._running = True
+        self.dropped = 0
+        self._thread.start()
+
+    def offload(self, seq_hash: int, k_dev, v_dev) -> None:
+        try:
+            self._q.put_nowait((seq_hash, k_dev, v_dev))
+        except queue.Full:
+            self.dropped += 1
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Block until every queued offload has *committed* (tests)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+    def stop(self) -> None:
+        """Stop the stream. Offloads still queued are discarded — the
+        tier is a cache, so shutdown loses nothing but future hits."""
+        self._running = False
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass  # worker is mid-backlog; it re-checks _running per item
+        self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while self._running:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                seq_hash, k_dev, v_dev = item
+                self.pool.store(seq_hash, np.asarray(k_dev), np.asarray(v_dev))
+            except Exception:  # never kill the stream on one bad page
+                log.exception("KV offload of page %x failed", item[0])
+            finally:
+                self._q.task_done()

@@ -48,6 +48,12 @@ class Sequence:
     hashed_pages: int = 0  # count of pages already registered
     # Set when the pool ran dry mid-decode; slot idles until a page frees.
     stalled: bool = False
+    # G2→G1 injections the engine must dispatch before this prefill:
+    # (page_id, seq_hash, k_page, v_page) per page (see kv_manager).
+    pending_uploads: list = field(default_factory=list)
+    # Chained hashes of all full prompt pages (from Allocation) so
+    # register_full_pages never rehashes prompt tokens.
+    prompt_hashes: list[int] = field(default_factory=list)
 
     @property
     def pos(self) -> int:
@@ -103,9 +109,14 @@ class Scheduler:
             if alloc is None:
                 return None  # pool exhausted; retry after some decode frees
             self.waiting.popleft()
-            seq.page_ids, seq.cached_len = alloc
+            seq.page_ids, seq.cached_len = alloc.page_ids, alloc.cached_len
+            seq.pending_uploads = alloc.uploads
+            seq.prompt_hashes = alloc.hashes
             seq.hashed_pages = seq.cached_len // self.kv.page_size
-            seq.parent_hash = self._hash_prefix(seq.prompt, seq.hashed_pages)
+            seq.parent_hash = (
+                alloc.hashes[seq.hashed_pages - 1] if seq.hashed_pages else None
+            )
+            self._register_uploads(seq, alloc.hashes)
             seq.tokens = list(seq.prompt)
             seq.slot = slot
             seq.state = SeqState.ACTIVE
@@ -114,13 +125,21 @@ class Scheduler:
             return seq
         return None
 
-    def _hash_prefix(self, tokens: list[int], num_pages: int) -> int | None:
+    def _register_uploads(self, seq: Sequence, hashes: list[int]) -> None:
+        """Pages coming back from the host tier are device-resident again:
+        register them so G1 matching + the router index see them."""
+        if not seq.pending_uploads:
+            return
         ps = self.kv.page_size
-        parent = None
-        for i in range(num_pages):
-            local = compute_block_hash(tokens[i * ps : (i + 1) * ps])
-            parent = chain_hash(parent, local)
-        return parent
+        first = seq.hashed_pages - len(seq.pending_uploads)
+        parent = hashes[first - 1] if first > 0 else None
+        for j, (pid, seq_hash, _, _) in enumerate(seq.pending_uploads):
+            i = first + j
+            block = seq.prompt[i * ps : (i + 1) * ps]
+            self.kv.register_full_page(
+                pid, seq_hash, parent_hash=parent, tokens=block
+            )
+            parent = seq_hash
 
     # ------------------------------------------------------------- lifecycle
     def ensure_decode_page(self, seq: Sequence, position: int) -> bool:
@@ -148,8 +167,13 @@ class Scheduler:
         while seq.hashed_pages < full:
             i = seq.hashed_pages
             block = seq.tokens[i * ps : (i + 1) * ps]
-            local = compute_block_hash(block)
-            seq_hash = chain_hash(seq.parent_hash, local)
+            if i < len(seq.prompt_hashes):
+                # Pure-prompt page: the chained hash was already computed
+                # at allocation; decode-era pages hash incrementally.
+                seq_hash = seq.prompt_hashes[i]
+            else:
+                local = compute_block_hash(block)
+                seq_hash = chain_hash(seq.parent_hash, local)
             self.kv.register_full_page(
                 seq.page_ids[i], seq_hash, parent_hash=seq.parent_hash, tokens=block
             )

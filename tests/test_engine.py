@@ -187,17 +187,35 @@ def test_kv_manager_lru_eviction():
     kv = KvPageManager(num_pages=4, page_size=4, event_cb=events.append)
     a = kv.allocate_sequence([1, 2, 3, 4, 5], max_pages=8)  # 2 pages
     assert a is not None
-    pages, cached = a
-    assert cached == 0
-    kv.register_full_page(pages[0], seq_hash=111, tokens=[1, 2, 3, 4])
-    kv.release_sequence(pages)
+    assert a.cached_len == 0
+    assert a.uploads == []
+    kv.register_full_page(a.page_ids[0], seq_hash=111, tokens=[1, 2, 3, 4])
+    kv.release_sequence(a.page_ids)
     # Page with hash 111 is parked; matching prompt revives it.
     b = kv.allocate_sequence([1, 2, 3, 4, 9], max_pages=8)
     assert b is not None
-    assert b[1] == 0 or b[1] == 4
+    assert b.cached_len in (0, 4)
     # Exhaust the pool so the parked page gets evicted.
-    kv.release_sequence(b[0])
+    kv.release_sequence(b.page_ids)
     c = kv.allocate_sequence(list(range(100, 116)), max_pages=8)  # 4 pages
     assert c is not None
     removed = [e for e in events if e.kind == "removed"]
     assert any(111 in e.seq_hashes for e in removed)
+
+
+def test_kv_manager_matched_parked_pages_not_double_counted():
+    """Regression: a prompt that both matches a parked page and needs
+    every remaining page must be deferred, not crash the allocator.
+
+    num_pages=4, ps=4: one registered parked page + 3 free. A 17-token
+    prompt matching that page needs 5 pages total -> must return None
+    (4 takeable pages would have been miscounted as satisfying
+    need_fresh=4 while the match also consumes the parked one)."""
+    from dynamo_exp_tpu.tokens import compute_block_hashes_for_seq
+
+    kv = KvPageManager(num_pages=4, page_size=4)
+    a = kv.allocate_sequence([1, 2, 3, 4, 5], max_pages=8)
+    h = compute_block_hashes_for_seq([1, 2, 3, 4], 4)[0]
+    kv.register_full_page(a.page_ids[0], seq_hash=h, tokens=[1, 2, 3, 4])
+    kv.release_sequence(a.page_ids)
+    assert kv.allocate_sequence([1, 2, 3, 4] + list(range(10, 23)), max_pages=8) is None
