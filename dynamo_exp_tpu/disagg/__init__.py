@@ -1,0 +1,33 @@
+"""Disaggregated prefill/decode serving.
+
+Capability parity with the reference's disaggregation stack
+(``/root/reference/docs/disagg_serving.md``, ``lib/llm/src/disagg_router.rs``,
+``examples/llm/components/{disagg_router,prefill_worker,worker}.py``,
+``examples/llm/utils/{prefill_queue,nats_queue}.py``), TPU-native:
+
+- decode workers conditionally push long prefills onto a shared work
+  queue (coordinator-backed JetStream equivalent);
+- prefill workers pull, run prefill on their own TPU slice, and stream
+  the computed KV pages to the decode worker over a direct TCP data
+  plane (the NIXL/RDMA write + notify equivalent — host-bounced numpy
+  pages moved with ``jax.device_put``-backed inject on arrival);
+- the remote/local decision is a live-reconfigurable config watched from
+  the control-plane KV store.
+"""
+
+from .config import DisaggConfig, DisaggConfigWatcher, disagg_config_key
+from .decode import DisaggDecodeEngine
+from .prefill_worker import PrefillWorker
+from .protocol import RemotePrefillRequest
+from .transfer import KvPageReceiver, send_kv_pages
+
+__all__ = [
+    "DisaggConfig",
+    "DisaggConfigWatcher",
+    "disagg_config_key",
+    "DisaggDecodeEngine",
+    "PrefillWorker",
+    "RemotePrefillRequest",
+    "KvPageReceiver",
+    "send_kv_pages",
+]

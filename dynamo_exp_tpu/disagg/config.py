@@ -1,0 +1,104 @@
+"""Disaggregation config: stored in the control-plane KV, watched live.
+
+Reference parity: ``DisaggRouterConf`` read from etcd key
+``public/components/disagg_router/models/chat/{model}`` with a live
+watch feeding runtime reconfiguration
+(``/root/reference/lib/llm/src/disagg_router.rs:24-262``) and the
+decision logic of ``examples/llm/components/disagg_router.py:1-66``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+from dataclasses import asdict, dataclass
+
+from ..runtime.transports.base import Discovery
+
+logger = logging.getLogger(__name__)
+
+
+def disagg_config_key(model: str) -> str:
+    return f"public/disagg_router/models/{model}"
+
+
+@dataclass
+class DisaggConfig:
+    """Tunables for the remote-prefill decision.
+
+    ``max_local_prefill_length``: prompts with more uncached tokens than
+    this go to a prefill worker. ``max_prefill_queue_size``: but not if
+    the queue is already this deep (prefill workers saturated — local
+    prefill beats queueing).
+    """
+
+    max_local_prefill_length: int = 1024
+    max_prefill_queue_size: int = 2
+
+    def prefill_remote(self, prefill_length: int, queue_size: int) -> bool:
+        return (
+            prefill_length > self.max_local_prefill_length
+            and queue_size < self.max_prefill_queue_size
+        )
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "DisaggConfig":
+        return cls(**json.loads(raw))
+
+
+class DisaggConfigWatcher:
+    """Live view of a model's DisaggConfig from the control-plane KV.
+
+    ``current()`` is synchronous and lock-free (read by the request hot
+    path); a background watch task applies updates as they land.
+    """
+
+    def __init__(
+        self,
+        discovery: Discovery,
+        model: str,
+        default: DisaggConfig | None = None,
+    ):
+        self._discovery = discovery
+        self._key = disagg_config_key(model)
+        self._config = default or DisaggConfig()
+        self._task: asyncio.Task | None = None
+
+    def current(self) -> DisaggConfig:
+        return self._config
+
+    async def start(self) -> None:
+        """Load the initial value, then follow updates."""
+        raw = await self._discovery.kv_get(self._key)
+        if raw:
+            self._config = DisaggConfig.from_bytes(raw)
+        self._task = asyncio.ensure_future(self._follow())
+
+    async def publish(self, config: DisaggConfig) -> None:
+        """Write a new config for every watcher of this model."""
+        await self._discovery.kv_put(self._key, config.to_bytes())
+
+    async def _follow(self) -> None:
+        try:
+            async for snapshot in self._discovery.kv_watch_prefix(self._key):
+                raw = snapshot.get(self._key)
+                if raw:
+                    try:
+                        self._config = DisaggConfig.from_bytes(raw)
+                        logger.info("disagg config updated: %s", self._config)
+                    except (ValueError, TypeError, KeyError):
+                        logger.warning("ignoring malformed disagg config")
+        except asyncio.CancelledError:
+            pass
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None

@@ -1,0 +1,87 @@
+"""Prefill worker: pulls the prefill queue, computes, ships KV pages.
+
+Reference parity: ``examples/llm/components/prefill_worker.py:31-194``
+(pull ``PrefillQueue``, NIXL-write computed blocks, notify). Graceful
+drain mirrors the reference's SIGTERM story: on cancellation the worker
+finishes the request it already pulled, then stops pulling
+(``/root/reference/docs/planner.md:47``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..engine.engine import TPUEngine
+from ..protocols.common import BackendInput, SamplingOptions
+from ..runtime.runtime import CancellationToken
+from ..runtime.transports.base import WorkQueue
+from .protocol import RemotePrefillRequest, kv_signature
+from .transfer import send_kv_pages
+
+logger = logging.getLogger(__name__)
+
+
+class PrefillWorker:
+    """One pull-loop around a TPU engine doing prefill-only work."""
+
+    def __init__(
+        self,
+        engine: TPUEngine,
+        queue: WorkQueue,
+        cancel: CancellationToken | None = None,
+    ):
+        self.engine = engine
+        self.queue = queue
+        self.cancel = cancel or CancellationToken()
+        self.served = 0  # requests completed (metrics)
+        self.failed = 0
+
+    async def run(self) -> None:
+        """Pull until cancelled. Short pull timeouts keep the drain
+        window tight without busy-waiting."""
+        while not self.cancel.is_cancelled():
+            item = await self.queue.pull(timeout_s=0.25)
+            if item is None:
+                continue
+            await self._serve_one(item)
+
+    async def _serve_one(self, item: bytes) -> None:
+        try:
+            req = RemotePrefillRequest.from_bytes(item)
+        except (ValueError, TypeError, KeyError):
+            logger.exception("malformed prefill request dropped")
+            self.failed += 1
+            return
+        if req.page_size and req.page_size != self.engine.cfg.page_size:
+            await self._fail(req, "page_size mismatch")
+            return
+        if req.model and req.model != kv_signature(self.engine.cfg):
+            await self._fail(req, "KV layout mismatch between fleets")
+            return
+        try:
+            binput = BackendInput(
+                token_ids=req.token_ids,
+                sampling_options=SamplingOptions(**req.sampling_options),
+            )
+            first_token, pages = await self.engine.prefill_extract(binput)
+        except Exception as e:  # noqa: BLE001 - report upstream, keep serving
+            logger.exception("prefill failed for %s", req.request_id)
+            await self._fail(req, f"{type(e).__name__}: {e}")
+            return
+        try:
+            await send_kv_pages(req.return_addr, req.request_id, first_token, pages)
+            self.served += 1
+        except Exception:  # noqa: BLE001 - a delivery failure (decode worker
+            # died, dropped the connection pre-ack, …) must never kill the
+            # pull loop; the decode side times out and prefills locally.
+            logger.warning(
+                "KV delivery failed for %s", req.request_id, exc_info=True
+            )
+            self.failed += 1
+
+    async def _fail(self, req: RemotePrefillRequest, error: str) -> None:
+        self.failed += 1
+        try:
+            await send_kv_pages(req.return_addr, req.request_id, 0, [], error=error)
+        except Exception:  # noqa: BLE001 - best-effort notification
+            logger.debug("could not deliver failure notice for %s", req.request_id)

@@ -1,0 +1,49 @@
+"""Wire types for the prefill queue and KV handoff.
+
+Reference parity: ``RemotePrefillRequest`` carried over the NATS
+JetStream prefill queue (``/root/reference/container/deps/vllm/…patch``
+``remote_prefill.py:4175+`` and ``examples/llm/utils/prefill_queue.py``).
+Ours carries the decode worker's KV-receiver address instead of NIXL
+agent metadata — the transfer plane is direct TCP, not RDMA.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..engine.config import EngineConfig
+
+
+def kv_signature(cfg: "EngineConfig") -> str:
+    """Structural identity of an engine's KV page layout. Both fleets
+    must agree or injected pages would be shape-garbage."""
+    m = cfg.model
+    return (
+        f"L{m.num_layers}-ps{cfg.page_size}-kv{m.num_kv_heads}"
+        f"-d{m.head_dim_}-{cfg.kv_dtype}"
+    )
+
+
+@dataclass
+class RemotePrefillRequest:
+    """One unit of prefill work pushed by a decode worker."""
+
+    request_id: str
+    token_ids: list[int]
+    # Where the prefill worker must deliver the pages (host:port of the
+    # decode worker's KvPageReceiver).
+    return_addr: str
+    sampling_options: dict = field(default_factory=dict)
+    # Sanity guards: both engines must agree on the KV layout.
+    page_size: int = 0
+    model: str = ""
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "RemotePrefillRequest":
+        return cls(**json.loads(raw))

@@ -1,0 +1,164 @@
+"""KV-page transfer plane: direct TCP between prefill and decode workers.
+
+The reference moves KV blocks GPU→GPU with NIXL/UCX RDMA writes plus a
+completion notification (``/root/reference/container/deps/vllm/…patch:
+1040-1862``). On TPU there is no peer-to-peer RDMA library; the
+equivalent is host-bounce: the prefill engine gathers pages to host
+numpy (XLA dynamic-slice + device→host DMA), this plane ships the bytes
+over one TCP message, and the decode engine injects them (host→device
+DMA + scatter). The two-part codec keeps the payload opaque — one frame
+carries every page of a request, so the handoff costs one round trip.
+
+Dtype note: pages travel as raw bytes tagged with the dtype name;
+bfloat16 numpy arrays (via ml_dtypes) round-trip through
+``tobytes``/``frombuffer`` losslessly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime.transports.codec import (
+    MsgType,
+    TwoPartMessage,
+    read_message,
+    write_message,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    if name == "bfloat16":
+        return np.dtype(jnp.bfloat16)
+    return np.dtype(name)
+
+
+def encode_pages(pages: list[tuple[np.ndarray, np.ndarray]]) -> tuple[dict, bytes]:
+    """Pack [(k_page, v_page), ...] into (header, payload)."""
+    if not pages:
+        return {"n_pages": 0, "shape": [], "dtype": "float32"}, b""
+    shape = list(pages[0][0].shape)
+    dtype = pages[0][0].dtype
+    buf = bytearray()
+    for k, v in pages:
+        buf += np.ascontiguousarray(k).tobytes()
+        buf += np.ascontiguousarray(v).tobytes()
+    return {"n_pages": len(pages), "shape": shape, "dtype": str(dtype)}, bytes(buf)
+
+
+def decode_pages(header: dict, payload: bytes) -> list[tuple[np.ndarray, np.ndarray]]:
+    n = header["n_pages"]
+    if n == 0:
+        return []
+    shape = tuple(header["shape"])
+    dtype = _dtype_from_name(header["dtype"])
+    per = int(np.prod(shape)) * dtype.itemsize
+    pages = []
+    for i in range(n):
+        off = i * 2 * per
+        k = np.frombuffer(payload, dtype, count=int(np.prod(shape)), offset=off)
+        v = np.frombuffer(payload, dtype, count=int(np.prod(shape)), offset=off + per)
+        pages.append((k.reshape(shape), v.reshape(shape)))
+    return pages
+
+
+async def send_kv_pages(
+    return_addr: str,
+    request_id: str,
+    first_token: int,
+    pages: list[tuple[np.ndarray, np.ndarray]],
+    error: str | None = None,
+) -> None:
+    """Deliver one prefill result (or failure notice) to a decode worker."""
+    host, _, port = return_addr.rpartition(":")
+    reader, writer = await asyncio.open_connection(host or "127.0.0.1", int(port))
+    try:
+        if error is not None:
+            msg = TwoPartMessage(
+                MsgType.ERROR, {"request_id": request_id, "error": error}
+            )
+        else:
+            header, payload = encode_pages(pages)
+            header.update({"request_id": request_id, "first_token": first_token})
+            msg = TwoPartMessage(MsgType.FRAME, header, payload)
+        await write_message(writer, msg)
+        # Wait for the ack so the pages are known-delivered before the
+        # prefill worker releases/reuses its device pages.
+        await read_message(reader)
+    finally:
+        writer.close()
+        with contextlib.suppress(Exception):
+            await writer.wait_closed()
+
+
+class KvPageReceiver:
+    """Decode-worker side: accepts prefill results, resolves per-request
+    futures. One receiver per decode worker process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self._pending: dict[str, asyncio.Future] = {}
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("KV receiver closed"))
+        self._pending.clear()
+
+    def expect(self, request_id: str) -> asyncio.Future:
+        """Register interest *before* queueing the prefill request, so the
+        result can't race past us."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[request_id] = fut
+        return fut
+
+    def forget(self, request_id: str) -> None:
+        self._pending.pop(request_id, None)
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        fut = None
+        try:
+            msg = await read_message(reader)
+            rid = msg.header.get("request_id", "")
+            fut = self._pending.pop(rid, None)
+            if fut is None or fut.done():
+                logger.warning("KV pages for unknown request %s dropped", rid)
+            elif msg.msg_type == MsgType.ERROR:
+                fut.set_exception(RuntimeError(msg.header.get("error", "prefill failed")))
+            else:
+                pages = decode_pages(msg.header, msg.payload)
+                fut.set_result((msg.header["first_token"], pages))
+            await write_message(writer, TwoPartMessage(MsgType.COMPLETE, {"ok": True}))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as e:  # noqa: BLE001 - a malformed frame must fail
+            # the waiting request *now*, not leave it to time out.
+            logger.exception("bad KV transfer frame")
+            if fut is not None and not fut.done():
+                fut.set_exception(RuntimeError(f"bad KV transfer frame: {e}"))
+        finally:
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()

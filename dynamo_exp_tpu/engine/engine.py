@@ -55,7 +55,7 @@ from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from .config import EngineConfig
 from .kv_manager import KvEvent, KvPageManager
 from .offload import CopyStream, HostKvPool
-from .scheduler import Scheduler, SeqState, Sequence
+from .scheduler import RemoteKv, Scheduler, SeqState, Sequence
 
 log = logging.getLogger(__name__)
 
@@ -108,17 +108,9 @@ class TPUEngine(AsyncEngine):
             self.host_pool = HostKvPool(
                 cfg.host_cache_pages, page_shape, cfg.kv_dtype_jnp
             )
+
             # The CopyStream (a live thread) is created by start(), so a
             # constructed-but-never-started engine owns no threads.
-            self._gather_page = jax.jit(lambda k, v, pid: (k[:, pid], v[:, pid]))
-            self._inject_page = jax.jit(
-                lambda k, v, pid, hk, hv: (
-                    k.at[:, pid].set(hk),
-                    v.at[:, pid].set(hv),
-                ),
-                donate_argnums=(0, 1),
-            )
-
             def on_evict(pid: int, seq_hash: int) -> None:
                 # Dispatch the on-device gather now (stream order protects
                 # it from the next donated forward); the CopyStream thread
@@ -134,6 +126,17 @@ class TPUEngine(AsyncEngine):
             on_evict=on_evict,
         )
         self.sched = Scheduler(cfg, self.kv)
+
+        # Per-page movement kernels, shared by the G2 offload tier and
+        # the disaggregation KV handoff (gather → wire / wire → inject).
+        self._gather_page = jax.jit(lambda k, v, pid: (k[:, pid], v[:, pid]))
+        self._inject_page = jax.jit(
+            lambda k, v, pid, hk, hv: (
+                k.at[:, pid].set(hk),
+                v.at[:, pid].set(hv),
+            ),
+            donate_argnums=(0, 1),
+        )
 
         B, V = cfg.max_decode_slots, mcfg.vocab_size
         self._counts = jnp.zeros((B, V), jnp.int32)  # penalty bookkeeping
@@ -214,7 +217,10 @@ class TPUEngine(AsyncEngine):
 
     # ------------------------------------------------------------ AsyncEngine
     async def generate(
-        self, request: dict | BackendInput, context: AsyncEngineContext | None = None
+        self,
+        request: dict | BackendInput,
+        context: AsyncEngineContext | None = None,
+        remote_kv: RemoteKv | None = None,
     ) -> ResponseStream[dict]:
         if not self._running:
             self.start()
@@ -236,6 +242,7 @@ class TPUEngine(AsyncEngine):
             stop=binput,
             emit=emit,
             is_cancelled=lambda: ctx.is_stopped,
+            remote_kv=remote_kv,
         )
         self._submit_q.put(seq)
         self._wake.set()
@@ -258,6 +265,55 @@ class TPUEngine(AsyncEngine):
 
         return ResponseStream(_gen(), ctx)
 
+    async def prefill_extract(
+        self,
+        request: dict | BackendInput,
+        context: AsyncEngineContext | None = None,
+    ) -> tuple[int, list]:
+        """Run prefill only and hand back (first_token, kv_pages).
+
+        This is the prefill-worker side of disaggregation: the prompt's
+        KV pages (host-bounced numpy, one (k, v) pair per page) travel to
+        the decode worker, which injects them via ``generate(...,
+        remote_kv=...)``. The pages also stay registered locally, so
+        repeated prompts prefix-hit this worker's pool.
+        """
+        if not self._running:
+            self.start()
+        ctx = context or AsyncEngineContext()
+        binput = (
+            request.model_copy(deep=True)  # never mutate the caller's object
+            if isinstance(request, BackendInput)
+            else BackendInput.model_validate(request)
+        )
+        binput.stop_conditions.max_tokens = 1  # prefill produces one token
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def extract_cb(token: int, pages: list) -> None:
+            loop.call_soon_threadsafe(
+                lambda: fut.done() or fut.set_result((token, pages))
+            )
+
+        def emit(tokens: list[int], reason: FinishReason | None) -> None:
+            if reason in (FinishReason.ERROR, FinishReason.CANCELLED):
+                loop.call_soon_threadsafe(
+                    lambda: fut.done()
+                    or fut.set_exception(RuntimeError(f"prefill failed: {reason}"))
+                )
+
+        seq = Sequence(
+            request_id=ctx.id,
+            prompt=list(binput.token_ids),
+            stop=binput,
+            emit=emit,
+            is_cancelled=lambda: ctx.is_stopped,
+            extract_cb=extract_cb,
+        )
+        self._submit_q.put(seq)
+        self._wake.set()
+        return await fut
+
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
         try:
@@ -270,7 +326,10 @@ class TPUEngine(AsyncEngine):
                 self._poll_cancellations()
                 seq = self.sched.next_prefill()
                 if seq is not None:
-                    self._run_prefill(seq)
+                    if seq.remote_kv is not None:
+                        self._run_remote_inject(seq)
+                    else:
+                        self._run_prefill(seq)
                 elif self.sched.active_count > 0:
                     self._run_decode()
         except Exception:  # engine death must not hang clients
@@ -305,17 +364,64 @@ class TPUEngine(AsyncEngine):
                 break
 
     # ---------------------------------------------------------------- prefill
+    def _apply_uploads(self, seq: Sequence) -> None:
+        """Re-inject G2 host pages into their fresh device pages before
+        the compute that attends over them (dispatch order on the device
+        stream makes this safe without explicit sync)."""
+        for pid, _h, hk, hv in seq.pending_uploads:
+            self.k_cache, self.v_cache = self._inject_page(
+                self.k_cache, self.v_cache, pid, jnp.asarray(hk), jnp.asarray(hv)
+            )
+        seq.pending_uploads = []
+
+    def _finish_first_token(self, seq: Sequence, token: int) -> None:
+        """Shared tail of the two admission paths (computed prefill or
+        remote-KV injection): record + announce the first sampled token."""
+        self._counts = self._reset_row(self._counts, seq.slot)
+        seq.tokens.append(token)
+        seq.generated = 1
+        self.sched.register_full_pages(seq)
+        if seq.extract_cb is not None:
+            seq.extract_cb(token, self._extract_prompt_pages(seq))
+        reason = self.sched.check_stop(seq, token)
+        seq.emit([token], None)
+        if reason is not None:
+            self.sched.finish(seq, reason)
+
+    def _extract_prompt_pages(self, seq: Sequence) -> list:
+        """Host-bounce every prompt page (incl. the partial tail) for the
+        disaggregation handoff. Runs on the engine loop thread: the
+        prefill worker's job is exactly this transfer."""
+        ps = self.cfg.page_size
+        n_pages = (len(seq.prompt) + ps - 1) // ps
+        pages = []
+        for pid in seq.page_ids[:n_pages]:
+            k_pg, v_pg = self._gather_page(self.k_cache, self.v_cache, pid)
+            pages.append((np.asarray(k_pg), np.asarray(v_pg)))
+        return pages
+
+    def _run_remote_inject(self, seq: Sequence) -> None:
+        """Disaggregated admission: prompt KV was computed by a remote
+        prefill worker — inject it and go straight to decode."""
+        self._apply_uploads(seq)
+        ps = self.cfg.page_size
+        rk = seq.remote_kv
+        n_pages = (len(seq.prompt) + ps - 1) // ps
+        start = seq.cached_len // ps  # locally matched/uploaded prefix
+        for i in range(start, min(n_pages, len(rk.pages))):
+            hk, hv = rk.pages[i]
+            self.k_cache, self.v_cache = self._inject_page(
+                self.k_cache,
+                self.v_cache,
+                seq.page_ids[i],
+                jnp.asarray(hk),
+                jnp.asarray(hv),
+            )
+        self._finish_first_token(seq, rk.first_token)
+
     def _run_prefill(self, seq: Sequence) -> None:
         cfg = self.cfg
-        if seq.pending_uploads:
-            # Re-inject G2 host pages into their fresh device pages before
-            # the prefill that attends over them (dispatch order on the
-            # device stream makes this safe without explicit sync).
-            for pid, _h, hk, hv in seq.pending_uploads:
-                self.k_cache, self.v_cache = self._inject_page(
-                    self.k_cache, self.v_cache, pid, jnp.asarray(hk), jnp.asarray(hv)
-                )
-            seq.pending_uploads = []
+        self._apply_uploads(seq)
         suffix = seq.prompt[seq.cached_len :]
         bucket = cfg.bucket_for(len(suffix))
         tokens = np.zeros((1, bucket), np.int32)
@@ -342,15 +448,7 @@ class TPUEngine(AsyncEngine):
             jnp.int32(so.top_k or 0),
             jnp.float32(so.top_p if so.top_p is not None else 1.0),
         )
-        self._counts = self._reset_row(self._counts, seq.slot)
-        token = int(tok)
-        seq.tokens.append(token)
-        seq.generated = 1
-        self.sched.register_full_pages(seq)
-        reason = self.sched.check_stop(seq, token)
-        seq.emit([token], None)
-        if reason is not None:
-            self.sched.finish(seq, reason)
+        self._finish_first_token(seq, int(tok))
 
     # ----------------------------------------------------------------- decode
     def _run_decode(self) -> None:

@@ -14,12 +14,27 @@ from __future__ import annotations
 import enum
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable
+from typing import TYPE_CHECKING, Callable
+
+if TYPE_CHECKING:
+    import numpy as np
 
 from ..protocols.common import BackendInput, FinishReason
 from ..tokens import chain_hash, compute_block_hash
 from .config import EngineConfig
 from .kv_manager import KvPageManager
+
+
+@dataclass
+class RemoteKv:
+    """Prefill computed elsewhere (disaggregation): the first sampled
+    token plus the prompt's KV pages, host-bounced as numpy arrays of
+    shape [L, page_size, Hkv, D] each (reference capability:
+    ``RemotePrefillParams`` + NIXL block writes,
+    ``/root/reference/container/deps/vllm/…patch:4175+``)."""
+
+    first_token: int
+    pages: "list[tuple[np.ndarray, np.ndarray]]"
 
 
 class SeqState(enum.Enum):
@@ -54,6 +69,13 @@ class Sequence:
     # Chained hashes of all full prompt pages (from Allocation) so
     # register_full_pages never rehashes prompt tokens.
     prompt_hashes: list[int] = field(default_factory=list)
+    # Disaggregation: KV pages precomputed by a remote prefill worker —
+    # the engine injects them and skips the prefill compute entirely.
+    remote_kv: "RemoteKv | None" = None
+    # Prefill-extraction mode (this engine IS the remote prefill worker):
+    # after prefill, gather the prompt's KV pages and hand them here as
+    # (first_token, [(k_page, v_page), ...]).
+    extract_cb: "Callable[[int, list], None] | None" = None
 
     @property
     def pos(self) -> int:

@@ -1,0 +1,258 @@
+"""Disaggregated prefill/decode tests.
+
+Reference capability anchors: conditional disagg decision
+(``examples/llm/components/worker.py:180-229``), live-watched router
+config (``lib/llm/src/disagg_router.rs``), prefill queue
+(``examples/llm/utils/nats_queue.py``), KV block handoff (NIXL patch).
+Here: two tiny TPU engines (same seed = same weights) on the virtual CPU
+mesh, an in-proc work queue, and the real TCP KV transfer plane.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.disagg import (
+    DisaggConfig,
+    DisaggConfigWatcher,
+    DisaggDecodeEngine,
+    KvPageReceiver,
+    PrefillWorker,
+    RemotePrefillRequest,
+    send_kv_pages,
+)
+from dynamo_exp_tpu.disagg.transfer import decode_pages, encode_pages
+from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+from dynamo_exp_tpu.models import TINY
+from dynamo_exp_tpu.parallel import single_device_mesh
+from dynamo_exp_tpu.protocols.common import BackendInput
+from dynamo_exp_tpu.runtime.runtime import CancellationToken
+from dynamo_exp_tpu.runtime.transports.inproc import (
+    InProcDiscovery,
+    InProcWorkQueue,
+)
+
+PS = 8
+
+
+# ------------------------------------------------------------------ decision
+def test_disagg_decision_logic():
+    cfg = DisaggConfig(max_local_prefill_length=100, max_prefill_queue_size=3)
+    assert not cfg.prefill_remote(prefill_length=100, queue_size=0)  # short enough
+    assert cfg.prefill_remote(prefill_length=101, queue_size=0)
+    assert cfg.prefill_remote(prefill_length=101, queue_size=2)
+    assert not cfg.prefill_remote(prefill_length=101, queue_size=3)  # queue full
+
+
+async def test_config_watcher_live_update():
+    disc = InProcDiscovery()
+    w = DisaggConfigWatcher(disc, "m", default=DisaggConfig(max_local_prefill_length=7))
+    await w.start()
+    try:
+        assert w.current().max_local_prefill_length == 7
+        await w.publish(DisaggConfig(max_local_prefill_length=99))
+        for _ in range(100):
+            if w.current().max_local_prefill_length == 99:
+                break
+            await asyncio.sleep(0.01)
+        assert w.current().max_local_prefill_length == 99
+    finally:
+        await w.close()
+
+
+# ------------------------------------------------------------------ transfer
+def test_page_codec_roundtrip_bfloat16():
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    dt = np.dtype(jnp.bfloat16)
+    pages = [
+        (
+            rs.randn(2, PS, 2, 4).astype(dt),
+            rs.randn(2, PS, 2, 4).astype(dt),
+        )
+        for _ in range(3)
+    ]
+    header, payload = encode_pages(pages)
+    out = decode_pages(header, payload)
+    assert len(out) == 3
+    for (k1, v1), (k2, v2) in zip(pages, out):
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+
+async def test_receiver_delivery_and_error():
+    recv = KvPageReceiver()
+    await recv.start()
+    try:
+        fut = recv.expect("r1")
+        pages = [(np.ones((1, 2, 1, 2), np.float32), np.zeros((1, 2, 1, 2), np.float32))]
+        await send_kv_pages(recv.address, "r1", 42, pages)
+        tok, got = await asyncio.wait_for(fut, 5)
+        assert tok == 42
+        np.testing.assert_array_equal(got[0][0], pages[0][0])
+
+        fut2 = recv.expect("r2")
+        await send_kv_pages(recv.address, "r2", 0, [], error="boom")
+        with pytest.raises(RuntimeError, match="boom"):
+            await asyncio.wait_for(fut2, 5)
+
+        # Unknown request ids are dropped without killing the server.
+        await send_kv_pages(recv.address, "never-registered", 1, [])
+    finally:
+        await recv.close()
+
+
+# ----------------------------------------------------------------------- e2e
+def make_engine(**kw) -> TPUEngine:
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=2,
+        page_size=PS,
+        num_pages=64,
+        max_model_len=128,
+        eos_token_ids=[],
+        kv_dtype="float32",  # bit-exact transfer assertions
+        **kw,
+    )
+    return TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+
+
+async def collect(engine, prompt, n):
+    b = BackendInput(token_ids=list(prompt))
+    b.stop_conditions.max_tokens = n
+    b.stop_conditions.ignore_eos = True
+    stream = await engine.generate(b.to_dict())
+    tokens = []
+    async for item in stream:
+        tokens.extend(item.get("token_ids", []))
+    return tokens
+
+
+async def test_disagg_e2e_matches_local():
+    """Remote-prefilled decode must produce exactly the local result."""
+    prefill_eng = make_engine()
+    decode_eng = make_engine()
+    local_eng = make_engine()
+    queue = InProcWorkQueue()
+    recv = KvPageReceiver()
+    await recv.start()
+    cancel = CancellationToken()
+    worker = PrefillWorker(prefill_eng, queue, cancel)
+    worker_task = asyncio.ensure_future(worker.run())
+    disc = InProcDiscovery()
+    watcher = DisaggConfigWatcher(
+        disc, "m", default=DisaggConfig(max_local_prefill_length=0)
+    )  # force every prefill remote
+    disagg = DisaggDecodeEngine(decode_eng, queue, recv, watcher)
+    try:
+        prompt = list(np.random.RandomState(3).randint(3, 200, size=3 * PS + 5))
+        want = await collect(local_eng, prompt, 10)
+
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = 10
+        b.stop_conditions.ignore_eos = True
+        stream = await disagg.generate(b.to_dict())
+        got = []
+        async for item in stream:
+            got.extend(item.get("token_ids", []))
+        assert got == want
+        assert disagg.remote_prefills == 1
+        assert worker.served == 1
+        # Decode engine never ran a prefill bucket (pure injection).
+        assert not decode_eng._prefill_fns
+    finally:
+        cancel.cancel()
+        await asyncio.wait_for(worker_task, 5)
+        await recv.close()
+        for e in (prefill_eng, decode_eng, local_eng):
+            e.stop()
+
+
+async def test_disagg_falls_back_to_local_when_no_prefill_worker():
+    decode_eng = make_engine()
+    local_eng = make_engine()
+    queue = InProcWorkQueue()
+    recv = KvPageReceiver()
+    await recv.start()
+    disc = InProcDiscovery()
+    watcher = DisaggConfigWatcher(
+        disc, "m", default=DisaggConfig(max_local_prefill_length=0)
+    )
+    disagg = DisaggDecodeEngine(
+        decode_eng, queue, recv, watcher, transfer_timeout_s=0.2
+    )
+    try:
+        prompt = list(np.random.RandomState(5).randint(3, 200, size=PS + 3))
+        want = await collect(local_eng, prompt, 6)
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = 6
+        b.stop_conditions.ignore_eos = True
+        stream = await disagg.generate(b.to_dict())
+        got = []
+        async for item in stream:
+            got.extend(item.get("token_ids", []))
+        assert got == want
+        assert disagg.local_fallbacks == 1
+        assert disagg.remote_prefills == 0
+    finally:
+        await recv.close()
+        for e in (decode_eng, local_eng):
+            e.stop()
+
+
+async def test_prefill_worker_rejects_kv_layout_mismatch():
+    from dynamo_exp_tpu.disagg.protocol import kv_signature
+
+    prefill_eng = make_engine()
+    queue = InProcWorkQueue()
+    recv = KvPageReceiver()
+    await recv.start()
+    cancel = CancellationToken()
+    worker = PrefillWorker(prefill_eng, queue, cancel)
+    worker_task = asyncio.ensure_future(worker.run())
+    try:
+        fut = recv.expect("mismatch")
+        req = RemotePrefillRequest(
+            request_id="mismatch",
+            token_ids=[4, 5, 6],
+            return_addr=recv.address,
+            page_size=PS,
+            model=kv_signature(prefill_eng.cfg) + "-different",
+        )
+        await queue.push(req.to_bytes())
+        with pytest.raises(RuntimeError, match="layout"):
+            await asyncio.wait_for(fut, 5)
+    finally:
+        cancel.cancel()
+        await asyncio.wait_for(worker_task, 5)
+        await recv.close()
+        prefill_eng.stop()
+
+
+async def test_prefill_worker_rejects_page_size_mismatch():
+    prefill_eng = make_engine()
+    queue = InProcWorkQueue()
+    recv = KvPageReceiver()
+    await recv.start()
+    cancel = CancellationToken()
+    worker = PrefillWorker(prefill_eng, queue, cancel)
+    worker_task = asyncio.ensure_future(worker.run())
+    try:
+        fut = recv.expect("bad")
+        req = RemotePrefillRequest(
+            request_id="bad",
+            token_ids=[4, 5, 6],
+            return_addr=recv.address,
+            page_size=PS + 1,  # wrong
+        )
+        await queue.push(req.to_bytes())
+        with pytest.raises(RuntimeError, match="page_size"):
+            await asyncio.wait_for(fut, 5)
+        assert worker.failed == 1
+    finally:
+        cancel.cancel()
+        await asyncio.wait_for(worker_task, 5)
+        await recv.close()
+        prefill_eng.stop()
