@@ -1,0 +1,111 @@
+"""Ingress model discovery: watch ``models/``, build serving chains.
+
+Capability parity with the reference's ModelWatcher
+(``/root/reference/lib/llm/src/http/service/discovery.rs:100-340``): on a
+new ModelEntry, fetch the ModelDeploymentCard from the object store and
+register a preprocessor→backend→router chain with the ModelManager; on
+removal (lease expiry = worker death), drop the model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+from ..local_model import MDC_BUCKET, MODELS_PREFIX, ModelEntry
+from ..model_card import ModelDeploymentCard
+from ..runtime.component import DistributedRuntime
+from ..runtime.push_router import RouterMode
+from ..runtime.transports.base import EndpointAddress
+from .service import ModelManager, build_pipeline_engine
+
+logger = logging.getLogger(__name__)
+
+
+class ModelWatcher:
+    """Keeps a ModelManager in sync with the discovery KV's ``models/``."""
+
+    def __init__(
+        self,
+        drt: DistributedRuntime,
+        manager: ModelManager,
+        router_mode: RouterMode = RouterMode.RANDOM,
+    ):
+        self.drt = drt
+        self.manager = manager
+        self.router_mode = router_mode
+        self._active: dict[str, str] = {}  # kv key -> model name
+        self._task: asyncio.Task | None = None
+        self._kv_routers: list = []  # keep references for stop()
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._watch())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+        for r in self._kv_routers:
+            await r.stop()
+
+    async def _watch(self) -> None:
+        async for snapshot in self.drt.discovery.kv_watch_prefix(MODELS_PREFIX):
+            try:
+                await self._apply(snapshot)
+            except Exception:  # noqa: BLE001 - keep watching on bad entries
+                logger.exception("model watch apply failed")
+
+    async def _apply(self, snapshot: dict[str, bytes]) -> None:
+        for key in list(self._active):
+            if key not in snapshot:
+                name = self._active.pop(key)
+                # N replicas write N keys for one model; drop the model
+                # only when the *last* replica's entry is gone.
+                if name not in self._active.values():
+                    self.manager.remove_model(name)
+                    logger.info("model %s removed (last worker gone)", name)
+        for key, raw in snapshot.items():
+            if key in self._active:
+                continue
+            # Per-entry guard: one bad entry (missing MDC, unreadable
+            # tokenizer path) must not block its siblings.
+            try:
+                entry = ModelEntry.from_bytes(raw)
+                if entry.name not in self._active.values():
+                    # First replica: build the chain. The chain's client
+                    # watches every live instance of the endpoint, so
+                    # later replicas of the same endpoint ride it too.
+                    engine = await self._build_chain(entry)
+                    if entry.model_type in ("chat", "both"):
+                        self.manager.add_chat_model(entry.name, engine)
+                    if entry.model_type in ("completion", "both"):
+                        self.manager.add_completion_model(entry.name, engine)
+                    logger.info(
+                        "model %s registered via %s", entry.name, entry.endpoint
+                    )
+                self._active[key] = entry.name
+            except Exception:  # noqa: BLE001 - retried on next KV change
+                logger.exception("failed to register model entry %s", key)
+
+    async def _build_chain(self, entry: ModelEntry):
+        raw = await self.drt.object_store.get(MDC_BUCKET, entry.mdc_key)
+        if raw is None:
+            raise RuntimeError(f"no MDC in object store for {entry.name}")
+        mdc = ModelDeploymentCard.from_json(raw.decode())
+        addr = EndpointAddress.from_url(entry.endpoint)
+        ep = (
+            self.drt.namespace(addr.namespace)
+            .component(addr.component)
+            .endpoint(addr.name)
+        )
+        from ..kv_router.router import build_routed_core
+
+        core, kv_router = await build_routed_core(
+            ep, self.router_mode, mdc.kv_cache_block_size
+        )
+        if kv_router is not None:
+            self._kv_routers.append(kv_router)
+        return build_pipeline_engine(mdc, core)

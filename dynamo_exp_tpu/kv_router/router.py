@@ -120,4 +120,20 @@ class KvPushRouter(AsyncEngine):
         )
 
 
-__all__ = ["KvRouter", "KvPushRouter", "RouterMode"]
+async def build_routed_core(endpoint, mode: RouterMode, block_size: int):
+    """The one place that composes a routed core engine for an endpoint.
+
+    Returns (engine, kv_router_or_None) — callers must ``await
+    kv_router.stop()`` when done (it owns an event subscription and a
+    scrape task). Used by both the ingress model watcher and the run CLI
+    so the two can't drift.
+    """
+    client = await endpoint.client()
+    if mode is RouterMode.KV:
+        kv_router = KvRouter(endpoint.component, block_size=block_size)
+        await kv_router.start()
+        return KvPushRouter(PushRouter(client, RouterMode.DIRECT), kv_router), kv_router
+    return PushRouter(client, mode), None
+
+
+__all__ = ["KvRouter", "KvPushRouter", "RouterMode", "build_routed_core"]

@@ -1,0 +1,73 @@
+"""LocalModel attach: publish a model so ingress can discover and serve it.
+
+Capability parity with the reference's ``LocalModel::attach`` +
+``register_llm`` flow (``/root/reference/lib/llm/src/local_model.rs:1-164``,
+``lib/bindings/python/rust/lib.rs:104-131``, ``http/service/discovery.rs:50-80``):
+the worker publishes its ModelDeploymentCard to the object store (bucket
+``mdc``) and writes a lease-scoped ModelEntry into the discovery KV under
+``models/``; frontends watch that prefix, fetch the card, and build the
+preprocessor→backend→router chain. Worker death revokes the lease, the
+entry disappears, and the frontend drops the model — elastic membership.
+
+Note: the card's ``tokenizer_path`` is a filesystem path, so frontends
+must share a filesystem (or model cache) with workers — the TPU-pod
+deployment story, where every host has the model directory.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+
+from .model_card import ModelDeploymentCard
+from .runtime.component import DistributedRuntime, Endpoint
+
+MDC_BUCKET = "mdc"
+MODELS_PREFIX = "models/"
+
+
+@dataclass
+class ModelEntry:
+    """What ingress needs to route to a served model."""
+
+    name: str
+    endpoint: str  # dyn://namespace.component.endpoint
+    model_type: str = "both"  # "chat" | "completion" | "both"
+    mdc_key: str = ""  # object-store key of the ModelDeploymentCard
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "ModelEntry":
+        return cls(**json.loads(raw))
+
+
+async def register_llm(
+    drt: DistributedRuntime,
+    endpoint: Endpoint,
+    model_path: str,
+    model_name: str | None = None,
+    model_type: str = "both",
+    kv_cache_block_size: int | None = None,
+) -> ModelEntry:
+    """Publish MDC + ModelEntry so frontends can discover this worker's
+    model. The entry rides the process's primary lease: if this worker
+    dies, ingress unregisters the model automatically."""
+    mdc = ModelDeploymentCard.from_local_path(model_path, model_name)
+    if kv_cache_block_size:
+        mdc.kv_cache_block_size = kv_cache_block_size
+    await drt.object_store.put(MDC_BUCKET, mdc.slug, mdc.to_json().encode())
+    entry = ModelEntry(
+        name=mdc.display_name,
+        endpoint=f"dyn://{endpoint.address.subject}",
+        model_type=model_type,
+        mdc_key=mdc.slug,
+    )
+    lease = await drt.primary_lease()
+    # Keyed per worker (lease id suffix): N replicas write N entries, and
+    # one replica's death removes only its own — the model stays served
+    # until the last replica is gone (reference keys entries per instance).
+    key = f"{MODELS_PREFIX}{mdc.slug}/{lease.lease_id}"
+    await drt.discovery.kv_put(key, entry.to_bytes(), lease)
+    return entry

@@ -1,0 +1,443 @@
+"""``dynamo-run`` equivalent: one CLI for every node shape.
+
+Capability parity with ``/root/reference/launch/dynamo-run/``
+(``src/lib.rs:57-404``, ``opt.rs``, ``input/*.rs``):
+
+    python -m dynamo_exp_tpu.run in=<INPUT> out=<OUTPUT> [flags]
+
+INPUT:  http | text | stdin | batch:<prompts.jsonl> | dyn://ns.comp.ep
+OUTPUT: tpu | echo_core | echo_full | dyn://ns.comp.ep
+
+Node shapes this builds (reference call stack §3.1/§3.2):
+- ``in=http out=tpu``      single-process OpenAI serve on the local TPU
+- ``in=http out=dyn://…``  ingress: HTTP + preprocessor + router to workers
+                           (with --model-path: static chain; without:
+                           dynamic model discovery via the coordinator)
+- ``in=dyn://… out=tpu``   worker: engine behind a discoverable endpoint,
+                           publishes model card + KV events + load metrics
+- ``in=text|stdin|batch:…`` local drivers for smoke tests and batch runs
+
+Router modes (``--router-mode``): random | round-robin | kv.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import sys
+import time
+
+logger = logging.getLogger("dynamo_exp_tpu.run")
+
+
+# ----------------------------------------------------------------- arguments
+def parse_args(argv: list[str]):
+    io = {"in": "text", "out": "echo_full"}
+    rest = []
+    for a in argv:
+        if a.startswith("in=") or a.startswith("out="):
+            k, _, v = a.partition("=")
+            io[k] = v
+        else:
+            rest.append(a)
+    p = argparse.ArgumentParser(prog="dynamo_exp_tpu.run", description=__doc__)
+    p.add_argument("--model-path", default="", help="HF-style model directory")
+    p.add_argument("--model-name", default="", help="served model name")
+    p.add_argument("--preset", default="", help="built-in model preset (random weights)")
+    p.add_argument("--random-weights", action="store_true",
+                   help="random-init instead of loading safetensors")
+    p.add_argument("--http-host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--coordinator", default=os.environ.get("DYN_COORDINATOR", ""),
+                   help="control-plane address host:port (enables dynamic mode)")
+    p.add_argument("--router-mode", default="random",
+                   choices=["random", "round-robin", "kv"])
+    # Engine shape (reference: --tensor-parallel-size etc., flags.rs:26-238).
+    p.add_argument("--tensor-parallel-size", "--tp", dest="tp", type=int, default=1)
+    p.add_argument("--max-decode-slots", type=int, default=16)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=0, help="0 = auto")
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--host-cache-pages", type=int, default=0)
+    p.add_argument("--kv-dtype", default="bfloat16", choices=["bfloat16", "float32"])
+    p.add_argument("--max-tokens", type=int, default=256, help="default completion cap")
+    p.add_argument("--echo-token-delay-ms", type=float, default=0.0)
+    opts = p.parse_args(rest)
+    opts.input, opts.output = io["in"], io["out"]
+    return opts
+
+
+ROUTER_MODES = {"random": "RANDOM", "round-robin": "ROUND_ROBIN", "kv": "KV"}
+
+
+def router_mode(opts):
+    from .runtime.push_router import RouterMode
+
+    return RouterMode[ROUTER_MODES[opts.router_mode]]
+
+
+# ------------------------------------------------------------------- engines
+def build_tpu_engine(opts):
+    """Construct the TPU engine (and MDC when a model dir is given)."""
+    from .engine import EngineConfig, TPUEngine
+    from .model_card import ModelDeploymentCard
+    from .models import PRESETS, ModelConfig
+
+    mdc = None
+    params = None
+    if opts.model_path:
+        mcfg = ModelConfig.from_pretrained(opts.model_path)
+        mdc = ModelDeploymentCard.from_local_path(
+            opts.model_path, opts.model_name or None
+        )
+        mdc.kv_cache_block_size = opts.page_size
+        has_weights = any(
+            f.endswith(".safetensors") for f in os.listdir(opts.model_path)
+        )
+        if has_weights and not opts.random_weights:
+            from .models.loader import load_params
+
+            params, mcfg = load_params(opts.model_path, mcfg)
+    elif opts.preset:
+        mcfg = PRESETS[opts.preset]
+    else:
+        raise SystemExit("out=tpu needs --model-path or --preset")
+
+    max_len = min(opts.max_model_len, mcfg.max_position_embeddings)
+    num_pages = opts.num_pages or (
+        opts.max_decode_slots * (max_len // opts.page_size + 1) + 64
+    )
+    ecfg = EngineConfig(
+        model=mcfg,
+        max_decode_slots=opts.max_decode_slots,
+        page_size=opts.page_size,
+        num_pages=num_pages,
+        max_model_len=max_len,
+        tp=opts.tp,
+        eos_token_ids=list(mdc.eos_token_ids) if mdc else [],
+        kv_dtype=opts.kv_dtype,
+        host_cache_pages=opts.host_cache_pages,
+        default_max_tokens=opts.max_tokens,
+    )
+    engine = TPUEngine(ecfg, params=params)
+    return engine, mdc
+
+
+def build_output(opts, drt):
+    """Resolve out=… to (core_engine, full_engine, mdc, tpu_engine)."""
+    from .engines.echo import EchoEngineCore, EchoEngineFull
+
+    if opts.output == "echo_core":
+        return EchoEngineCore(opts.echo_token_delay_ms), None, None, None
+    if opts.output == "echo_full":
+        return None, EchoEngineFull(opts.echo_token_delay_ms), None, None
+    if opts.output == "tpu":
+        engine, mdc = build_tpu_engine(opts)
+        return engine, None, mdc, engine
+    if opts.output.startswith("dyn://"):
+        return None, None, None, None  # resolved by the input builder
+    raise SystemExit(f"unknown out={opts.output!r}")
+
+
+async def remote_core(opts, drt, block_size: int):
+    """out=dyn://… : a core-engine seam over the request plane.
+
+    Returns (engine, kv_router_or_None); the caller stops the router."""
+    from .kv_router.router import build_routed_core
+    from .runtime.transports.base import EndpointAddress
+
+    addr = EndpointAddress.from_url(opts.output)
+    ep = drt.namespace(addr.namespace).component(addr.component).endpoint(addr.name)
+    return await build_routed_core(ep, router_mode(opts), block_size)
+
+
+def require_mdc(opts):
+    from .model_card import ModelDeploymentCard
+
+    if not opts.model_path:
+        raise SystemExit(f"in={opts.input} with out={opts.output} needs --model-path")
+    mdc = ModelDeploymentCard.from_local_path(opts.model_path, opts.model_name or None)
+    mdc.kv_cache_block_size = opts.page_size
+    return mdc
+
+
+# -------------------------------------------------------------------- inputs
+async def run_http(opts, drt, core, full, mdc):
+    """OpenAI ingress (reference: input/http.rs + http/service)."""
+    from .http import HttpService, build_pipeline_engine
+    from .http.discovery import ModelWatcher
+
+    svc = HttpService(host=opts.http_host, port=opts.http_port)
+    watcher = None
+    kv_router = None
+    if opts.output.startswith("dyn://") and not opts.model_path:
+        # Dynamic: models appear/disappear with workers (discovery.rs).
+        watcher = ModelWatcher(drt, svc.manager, router_mode(opts))
+        await watcher.start()
+    else:
+        if opts.output.startswith("dyn://"):
+            mdc = require_mdc(opts)
+            core, kv_router = await remote_core(opts, drt, mdc.kv_cache_block_size)
+        if core is not None and mdc is None:
+            mdc = require_mdc(opts)  # core engines need tokenizer/template
+        name = (mdc.display_name if mdc else "") or opts.model_name or "default"
+        engine = build_pipeline_engine(mdc, core) if core is not None else full
+        svc.manager.add_chat_model(name, engine)
+        svc.manager.add_completion_model(name, engine)
+    port = await svc.start()
+    print(f"listening on http://{opts.http_host}:{port}", flush=True)
+    try:
+        await drt.runtime.primary_token.cancelled()
+    finally:
+        if watcher:
+            await watcher.close()
+        if kv_router is not None:
+            await kv_router.stop()
+        await svc.stop()
+
+
+async def run_worker(opts, drt, core, tpu_engine):
+    """Worker node: serve the core engine on a discoverable endpoint
+    (reference: EngineConfig::StaticCore + Ingress, lib.rs:200-300)."""
+    from .kv_router.publisher import KvEventPublisher, KvMetricsPublisher
+    from .local_model import register_llm
+    from .runtime.component import annotated_stream
+    from .runtime.engine import AsyncEngineContext
+    from .runtime.transports.base import EndpointAddress
+
+    addr = EndpointAddress.from_url(opts.input)
+    ep = drt.namespace(addr.namespace).component(addr.component).endpoint(addr.name)
+
+    async def handler(request: dict, context: AsyncEngineContext):
+        async for frame in annotated_stream(core, request, context):
+            yield frame
+
+    metrics_pub = KvMetricsPublisher()
+    served = await ep.serve_endpoint(handler, stats_handler=metrics_pub.stats_handler)
+
+    if tpu_engine is not None:
+        # KV events -> router index, attributed to this instance.
+        kv_pub = KvEventPublisher(
+            drt.event_plane,
+            ep.component.path,
+            served.instance_id,
+            loop=asyncio.get_running_loop(),
+        )
+        tpu_engine.kv.event_cb = kv_pub.engine_callback()
+
+        async def pump_metrics():
+            from .kv_router.protocols import ForwardPassMetrics
+
+            while True:
+                await asyncio.sleep(0.5)
+                metrics_pub.update(ForwardPassMetrics.from_dict(tpu_engine.metrics()))
+
+        drt.runtime.spawn(pump_metrics())
+    if opts.model_path:
+        await register_llm(
+            drt, ep, opts.model_path, opts.model_name or None,
+            kv_cache_block_size=opts.page_size,
+        )
+    print(f"worker serving {opts.input} (instance {served.instance_id})", flush=True)
+    try:
+        await drt.runtime.primary_token.cancelled()
+    finally:
+        # Bounded: an unresponsive coordinator must not wedge shutdown
+        # (the lease expiring cleans up registrations anyway).
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(served.close(), 15)
+
+
+def _chat_payload(model: str, prompt: str, opts) -> dict:
+    return {
+        "model": model,
+        "messages": [{"role": "user", "content": prompt}],
+        "stream": True,
+        "max_tokens": opts.max_tokens,
+    }
+
+
+async def _stream_chat(engine, payload, out=sys.stdout):
+    from .runtime.engine import AsyncEngineContext
+
+    n_tokens = 0
+    first = None
+    t0 = time.monotonic()
+    stream = await engine.generate(payload, AsyncEngineContext())
+    async for item in stream:
+        chunk = item if isinstance(item, dict) else item.model_dump()
+        for choice in chunk.get("choices", []):
+            text = (choice.get("delta") or {}).get("content")
+            if text:
+                if first is None:
+                    first = time.monotonic() - t0
+                n_tokens += 1
+                out.write(text)
+                out.flush()
+    return n_tokens, first, time.monotonic() - t0
+
+
+async def run_text(opts, drt, engine, mdc):
+    """Interactive chat REPL (reference: input/text.rs).
+
+    stdin is read on a dedicated *daemon* thread: the default executor's
+    threads are non-daemon and joined at interpreter exit, so a thread
+    blocked in input() would keep the process alive after Ctrl-C."""
+    import threading
+
+    name = (mdc.display_name if mdc else "") or "default"
+    loop = asyncio.get_running_loop()
+    lines: asyncio.Queue = asyncio.Queue()
+
+    def _reader():
+        while True:
+            try:
+                line = input("> ")
+            except EOFError:
+                loop.call_soon_threadsafe(lines.put_nowait, None)
+                return
+            loop.call_soon_threadsafe(lines.put_nowait, line)
+
+    threading.Thread(target=_reader, name="stdin-reader", daemon=True).start()
+    print("Ctrl-D to exit.", flush=True)
+    while True:
+        prompt = await lines.get()
+        if prompt is None:
+            return
+        await _stream_chat(engine, _chat_payload(name, prompt, opts))
+        print(flush=True)
+
+
+async def run_stdin(opts, drt, engine, mdc):
+    """One prompt per stdin line, streamed to stdout."""
+    name = (mdc.display_name if mdc else "") or "default"
+    for line in sys.stdin:
+        prompt = line.rstrip("\n")
+        if not prompt:
+            continue
+        await _stream_chat(engine, _chat_payload(name, prompt, opts))
+        print(flush=True)
+
+
+async def run_batch(opts, drt, engine, mdc, path: str):
+    """JSONL prompts, concurrent, tok/s stats (reference: input/batch.rs)."""
+    name = (mdc.display_name if mdc else "") or "default"
+    prompts = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                d = json.loads(line)
+                prompts.append(d.get("text") or d.get("prompt") or "")
+
+    class _Null:
+        def write(self, s):  # batch mode: tokens counted, not printed
+            pass
+
+        def flush(self):
+            pass
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(
+        *[
+            _stream_chat(engine, _chat_payload(name, p, opts), out=_Null())
+            for p in prompts
+        ]
+    )
+    wall = time.monotonic() - t0
+    total = sum(r[0] for r in results)
+    ttfts = sorted(r[1] for r in results if r[1] is not None)
+    stats = {
+        "requests": len(prompts),
+        "output_tokens": total,
+        "wall_s": round(wall, 3),
+        "output_tok_s": round(total / wall, 2) if wall else 0.0,
+        "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1000, 1) if ttfts else None,
+    }
+    print(json.dumps(stats), flush=True)
+
+
+# --------------------------------------------------------------------- main
+async def main_async(opts) -> None:
+    from .http import build_pipeline_engine
+    from .runtime.component import DistributedRuntime
+    from .runtime.config import RuntimeConfig
+
+    needs_cluster = opts.input.startswith("dyn://") or opts.output.startswith("dyn://")
+    if needs_cluster and not opts.coordinator:
+        raise SystemExit("dyn:// endpoints need --coordinator (or DYN_COORDINATOR)")
+    cfg = RuntimeConfig.from_settings()
+    if opts.coordinator:
+        cfg.coordinator_endpoint = opts.coordinator
+    drt = DistributedRuntime(config=cfg)
+
+    core, full, mdc, tpu_engine = build_output(opts, drt)
+    try:
+        if opts.input == "http":
+            await run_http(opts, drt, core, full, mdc)
+            return
+        if opts.input.startswith("dyn://"):
+            if core is None:
+                raise SystemExit("in=dyn:// needs a local engine (out=tpu|echo_core)")
+            await run_worker(opts, drt, core, tpu_engine)
+            return
+        # Local text-ish drivers need an OpenAI-level engine.
+        kv_router = None
+        if opts.output.startswith("dyn://"):
+            mdc = require_mdc(opts)
+            core, kv_router = await remote_core(opts, drt, mdc.kv_cache_block_size)
+        if core is not None:
+            if mdc is None:
+                mdc = require_mdc(opts)
+            engine = build_pipeline_engine(mdc, core)
+        else:
+            engine = full
+        try:
+            if opts.input == "text":
+                await run_text(opts, drt, engine, mdc)
+            elif opts.input == "stdin":
+                await run_stdin(opts, drt, engine, mdc)
+            elif opts.input.startswith("batch:"):
+                await run_batch(opts, drt, engine, mdc, opts.input[len("batch:") :])
+            else:
+                raise SystemExit(f"unknown in={opts.input!r}")
+        finally:
+            if kv_router is not None:
+                await kv_router.stop()
+    finally:
+        if tpu_engine is not None:
+            tpu_engine.stop()
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(drt.close(), 15)
+
+
+def main(argv: list[str] | None = None) -> None:
+    logging.basicConfig(
+        level=os.environ.get("DYN_LOG", "INFO").upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    opts = parse_args(argv if argv is not None else sys.argv[1:])
+    loop = asyncio.new_event_loop()
+    main_task = loop.create_task(main_async(opts))
+    # SIGINT/SIGTERM -> cancel -> graceful drain (reference worker.rs).
+    import signal
+
+    def _cancel(*_):
+        main_task.cancel()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError, ValueError):
+            loop.add_signal_handler(sig, _cancel)
+    try:
+        loop.run_until_complete(main_task)
+    except asyncio.CancelledError:
+        pass
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Model registration + ingress discovery semantics.
+
+Reference capability: ``register_llm`` + ModelWatcher flow
+(``/root/reference/lib/llm/src/http/service/discovery.rs:50-340``),
+including the elastic-membership story: per-replica entries under
+per-worker leases, model dropped only when the last replica dies.
+"""
+
+import asyncio
+
+from dynamo_exp_tpu.http.discovery import ModelWatcher
+from dynamo_exp_tpu.http.service import ModelManager
+from dynamo_exp_tpu.local_model import register_llm
+from dynamo_exp_tpu.runtime.component import DistributedRuntime
+from dynamo_exp_tpu.runtime.transports.inproc import (
+    InProcDiscovery,
+    InProcRequestPlane,
+)
+
+from .fixtures import build_tiny_model_dir
+
+
+async def _wait_for(cond, timeout=5.0):
+    for _ in range(int(timeout / 0.02)):
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return cond()
+
+
+async def test_replica_death_keeps_model_until_last(tmp_path):
+    model_dir = build_tiny_model_dir(str(tmp_path / "m"))
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    # Two "worker processes" sharing one discovery fabric.
+    w1 = DistributedRuntime(discovery=disc, request_plane=plane)
+    w2 = DistributedRuntime(discovery=disc, request_plane=plane)
+    ingress = DistributedRuntime(discovery=disc, request_plane=plane)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(ingress, manager)
+    await watcher.start()
+    try:
+        ep1 = w1.namespace("t").component("w").endpoint("generate")
+        ep2 = w2.namespace("t").component("w").endpoint("generate")
+        await register_llm(w1, ep1, model_dir, "tiny")
+        await register_llm(w2, ep2, model_dir, "tiny")
+        assert await _wait_for(lambda: "tiny" in manager.model_names())
+
+        # First replica dies -> its entry goes, model must stay.
+        lease1 = await w1.primary_lease()
+        await lease1.revoke()
+        await asyncio.sleep(0.1)
+        assert "tiny" in manager.model_names()
+
+        # Last replica dies -> model dropped from ingress.
+        lease2 = await w2.primary_lease()
+        await lease2.revoke()
+        assert await _wait_for(lambda: "tiny" not in manager.model_names())
+    finally:
+        await watcher.close()
+
+
+async def test_bad_entry_does_not_block_siblings(tmp_path):
+    model_dir = build_tiny_model_dir(str(tmp_path / "m"))
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    worker = DistributedRuntime(discovery=disc, request_plane=plane)
+    ingress = DistributedRuntime(discovery=disc, request_plane=plane)
+
+    # A malformed entry that sorts before the good one.
+    await disc.kv_put("models/aaa-broken/1", b"not json")
+
+    manager = ModelManager()
+    watcher = ModelWatcher(ingress, manager)
+    await watcher.start()
+    try:
+        ep = worker.namespace("t").component("w").endpoint("generate")
+        await register_llm(worker, ep, model_dir, "tiny")
+        assert await _wait_for(lambda: "tiny" in manager.model_names())
+    finally:
+        await watcher.close()

@@ -1,0 +1,178 @@
+"""dynamo-run-equivalent CLI tests.
+
+Reference capability: ``/root/reference/launch/dynamo-run/`` — one CLI
+building every node shape. Covered here: arg parsing, the local batch
+driver on a real tiny TPU engine, and the flagship 3-process flow
+(coordinator + worker subprocess + in-proc HTTP ingress with dynamic
+model discovery), including elastic model removal on worker death.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_exp_tpu.run import main_async, parse_args
+
+from .fixtures import build_tiny_model_dir
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parse_args_io_and_flags():
+    opts = parse_args(
+        ["in=http", "out=dyn://ns.comp.ep", "--router-mode", "kv", "--tp", "2"]
+    )
+    assert opts.input == "http"
+    assert opts.output == "dyn://ns.comp.ep"
+    assert opts.router_mode == "kv"
+    assert opts.tp == 2
+    # defaults
+    d = parse_args([])
+    assert (d.input, d.output) == ("text", "echo_full")
+
+
+async def test_batch_driver_on_tpu_engine(tmp_path, capsys):
+    model_dir = build_tiny_model_dir(str(tmp_path / "model"))
+    prompts = tmp_path / "p.jsonl"
+    prompts.write_text(
+        "\n".join(json.dumps({"text": t}) for t in ["hello world", "the quick fox"])
+    )
+    opts = parse_args(
+        [
+            f"in=batch:{prompts}",
+            "out=tpu",
+            "--model-path", model_dir,
+            "--random-weights",
+            "--max-tokens", "8",
+            "--max-decode-slots", "2",
+            "--page-size", "8",
+            "--max-model-len", "128",
+            "--kv-dtype", "float32",
+        ]
+    )
+    await main_async(opts)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    stats = json.loads(out)
+    assert stats["requests"] == 2
+    # 8 tokens per request max; random weights may sample EOS earlier.
+    assert 2 <= stats["output_tokens"] <= 16
+    assert stats["output_tok_s"] > 0
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def test_three_process_serve_with_discovery(tmp_path):
+    """coordinator + CLI worker subprocess + CLI HTTP ingress, dynamic
+    model discovery, elastic removal on worker death."""
+    import aiohttp
+
+    from dynamo_exp_tpu.runtime.transports.coordinator import CoordinatorServer
+
+    model_dir = build_tiny_model_dir(str(tmp_path / "model"))
+    server = CoordinatorServer()
+    await server.start()
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    worker = subprocess.Popen(
+        [
+            sys.executable, "-m", "dynamo_exp_tpu.run",
+            "in=dyn://t.worker.generate", "out=tpu",
+            "--model-path", model_dir,
+            "--model-name", "tiny",
+            "--random-weights",
+            "--coordinator", server.address,
+            "--max-decode-slots", "2",
+            "--page-size", "8",
+            "--max-model-len", "128",
+            "--kv-dtype", "float32",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+    port = _free_port()
+    ingress_opts = parse_args(
+        [
+            "in=http", "out=dyn://t.worker.generate",
+            "--coordinator", server.address,
+            "--http-host", "127.0.0.1", "--http-port", str(port),
+        ]
+    )
+    ingress = asyncio.ensure_future(main_async(ingress_opts))
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as http:
+            # Wait for ingress up + worker's model discovered.
+            for _ in range(600):
+                if worker.poll() is not None:
+                    raise AssertionError(
+                        "worker died:\n" + worker.stdout.read()
+                    )
+                try:
+                    r = await http.get(base + "/v1/models")
+                    models = [m["id"] for m in (await r.json())["data"]]
+                    if "tiny" in models:
+                        break
+                except aiohttp.ClientConnectionError:
+                    pass
+                await asyncio.sleep(0.25)
+            else:
+                raise AssertionError("model never discovered")
+
+            r = await http.post(
+                base + "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "stream": False,
+                    "max_tokens": 4,
+                },
+            )
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            assert data["choices"][0]["message"]["content"]
+
+            # Elastic removal: kill the worker; lease expiry must drop
+            # the model from ingress. NB: poll asynchronously — a blocking
+            # worker.wait() would freeze this loop, which also hosts the
+            # coordinator the worker's graceful shutdown talks to.
+            worker.send_signal(signal.SIGTERM)
+            for _ in range(120):
+                if worker.poll() is not None:
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise AssertionError("worker did not exit on SIGTERM")
+            for _ in range(240):
+                r = await http.get(base + "/v1/models")
+                models = [m["id"] for m in (await r.json())["data"]]
+                if "tiny" not in models:
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise AssertionError("model not removed after worker death")
+    finally:
+        ingress.cancel()
+        try:
+            await ingress
+        except (asyncio.CancelledError, Exception):
+            pass
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait(timeout=10)
+        await server.close()
