@@ -37,7 +37,7 @@ class ModelWatcher:
         self.router_mode = router_mode
         self._active: dict[str, str] = {}  # kv key -> model name
         self._task: asyncio.Task | None = None
-        self._kv_routers: list = []  # keep references for stop()
+        self._kv_routers: dict[str, object] = {}  # model name -> KvRouter
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._watch())
@@ -48,15 +48,27 @@ class ModelWatcher:
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
             self._task = None
-        for r in self._kv_routers:
+        for r in self._kv_routers.values():
             await r.stop()
+        self._kv_routers.clear()
 
     async def _watch(self) -> None:
-        async for snapshot in self.drt.discovery.kv_watch_prefix(MODELS_PREFIX):
+        # The watch stream itself can break (coordinator hiccup); an
+        # ingress must re-establish it, not freeze its model set.
+        while True:
             try:
-                await self._apply(snapshot)
-            except Exception:  # noqa: BLE001 - keep watching on bad entries
-                logger.exception("model watch apply failed")
+                async for snapshot in self.drt.discovery.kv_watch_prefix(
+                    MODELS_PREFIX
+                ):
+                    try:
+                        await self._apply(snapshot)
+                    except Exception:  # noqa: BLE001 - keep watching
+                        logger.exception("model watch apply failed")
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - reconnect after backoff
+                logger.exception("model watch stream broke; retrying")
+                await asyncio.sleep(1.0)
 
     async def _apply(self, snapshot: dict[str, bytes]) -> None:
         for key in list(self._active):
@@ -66,6 +78,9 @@ class ModelWatcher:
                 # only when the *last* replica's entry is gone.
                 if name not in self._active.values():
                     self.manager.remove_model(name)
+                    router = self._kv_routers.pop(name, None)
+                    if router is not None:
+                        await router.stop()  # drop its event sub + scrape loop
                     logger.info("model %s removed (last worker gone)", name)
         for key, raw in snapshot.items():
             if key in self._active:
@@ -107,5 +122,5 @@ class ModelWatcher:
             ep, self.router_mode, mdc.kv_cache_block_size
         )
         if kv_router is not None:
-            self._kv_routers.append(kv_router)
+            self._kv_routers[entry.name] = kv_router
         return build_pipeline_engine(mdc, core)

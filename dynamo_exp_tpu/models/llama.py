@@ -193,3 +193,77 @@ def forward(
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = (x @ head).astype(jnp.float32)
     return logits, new_k, new_v
+
+
+def forward_ring_prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] — T must divide by the mesh's sp size
+    positions: jnp.ndarray,  # [B, T] int32, -1 for padding
+    mesh,
+    sp_axis: str = "sp",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sequence-parallel long-context prefill via ring attention.
+
+    A capability beyond the reference (SURVEY.md §5: it has no context
+    parallelism of its own): the sequence axis is sharded over ``sp``,
+    every non-attention op is local, and attention rotates K/V blocks
+    around the ring (``ops/ring_attention.py``). Peak per-device
+    activation memory scales 1/sp, so prefills longer than one chip's
+    HBM limit become possible.
+
+    Params are replicated over ``sp`` (shard params over ``tp`` and keep
+    sp a separate axis). Returns (logits [B,T,V], k, v [L,B,T,Hkv,D]),
+    all sharded over T — the caller scatters K/V into its page pool or
+    hands them to the disaggregation transfer plane.
+    """
+    from functools import partial as _partial
+
+    from jax import shard_map
+
+    from ..ops.ring_attention import ring_attention
+
+    sp = mesh.shape[sp_axis]
+    B, T = tokens.shape
+    if T % sp:
+        raise ValueError(f"seq len {T} not divisible by sp={sp}")
+    hd = cfg.head_dim_
+    eps = cfg.rms_norm_eps
+    inv_freq = rope_frequencies(hd, cfg.rope_theta, cfg.rope_scaling)
+    seq = P(None, sp_axis)
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), seq, seq),
+        out_specs=(seq, P(None, None, sp_axis), P(None, None, sp_axis)),
+        check_vma=False,
+    )
+    def fwd(params, tokens_l, pos_l):
+        x = jnp.take(params["embed"], tokens_l, axis=0)
+        rope_pos = jnp.maximum(pos_l, 0)
+
+        def layer(x, lp):
+            Bl, Tl = x.shape[:2]
+            h = rms_norm(x, lp["attn_norm"], eps)
+            q = (h @ lp["wq"]).reshape(Bl, Tl, cfg.num_heads, hd)
+            k = (h @ lp["wk"]).reshape(Bl, Tl, cfg.num_kv_heads, hd)
+            v = (h @ lp["wv"]).reshape(Bl, Tl, cfg.num_kv_heads, hd)
+            q = apply_rope(q, rope_pos, inv_freq)
+            k = apply_rope(k, rope_pos, inv_freq)
+            attn = ring_attention(q, k, v, pos_l, pos_l, sp_axis, sp)
+            x = x + attn.reshape(Bl, Tl, cfg.num_heads * hd) @ lp["wo"]
+            h = rms_norm(x, lp["mlp_norm"], eps)
+            gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+            return x, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+        x = rms_norm(x, params["final_norm"], eps)
+        head = (
+            params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+        )
+        logits = (x @ head).astype(jnp.float32)
+        return logits, ks, vs
+
+    return fwd(params, tokens, positions)

@@ -1,0 +1,107 @@
+"""Ring attention: causal attention with the sequence sharded over a mesh
+axis, K/V blocks rotating around the ring via ``ppermute``.
+
+This is a capability the reference does NOT have (SURVEY.md §5: no
+sequence/context parallelism anywhere in its tree — long sequences are
+delegated to the wrapped engines). On TPU it is the natural long-context
+prefill path: each device holds T/n of the sequence, peak activation
+memory scales 1/n, and the K/V rotation rides ICI neighbor links while
+the MXU computes the current block — communication hides behind compute.
+
+Math: flash-style online softmax. Each ring step merges one K/V block
+into the running (max, denominator, numerator) triple; masked entries
+are multiplied out, so fully-masked (query, block) pairs contribute
+exactly zero and rows that never see a valid key return zeros.
+
+All functions here run *inside* ``shard_map`` — shapes are per-device
+locals and ``axis_name`` refers to the sequence axis of the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = jnp.float32(-1e30)
+
+
+def _merge_block(qg, k, v, q_pos, kv_pos, m, l, o, scale):
+    """Merge one K/V block into the online-softmax state.
+
+    qg:     [B, Tq, Hkv, G, D] float32 (grouped query heads)
+    k, v:   [B, Tk, Hkv, D]
+    q_pos:  [B, Tq] int32 (-1 = padding)
+    kv_pos: [B, Tk] int32 (-1 = padding)
+    m, l:   [B, Hkv, G, Tq] running max / denominator
+    o:      [B, Hkv, G, Tq, D] running numerator
+    """
+    kf = k.astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, kf) * scale  # [B,Hkv,G,Tq,Tk]
+    mask = (
+        (kv_pos[:, None, None, None, :] >= 0)
+        & (q_pos[:, None, None, :, None] >= 0)
+        & (kv_pos[:, None, None, None, :] <= q_pos[:, None, None, :, None])
+    )
+    scores = jnp.where(mask, scores, _NEG)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # Multiplicative mask: an all-masked block keeps m at the -1e30 floor,
+    # where exp(scores - m_new) would be 1 — the mask zeroes it instead.
+    p = jnp.exp(scores - m_new[..., None]) * mask
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction[..., None] + jnp.einsum(
+        "bkgts,bskd->bkgtd", p, v.astype(jnp.float32)
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, Tq_local, H, D]
+    k: jnp.ndarray,  # [B, Tk_local, Hkv, D]
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,  # [B, Tq_local] global positions, -1 = padding
+    kv_pos: jnp.ndarray,  # [B, Tk_local]
+    axis_name: str,
+    axis_size: int,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal GQA attention over a ring-sharded sequence.
+
+    Every device starts with its own K/V block and passes it around the
+    ring ``axis_size`` times; positions travel with the blocks, so the
+    causal mask is global-position-exact regardless of ring layout.
+    Returns [B, Tq_local, H, D] in q's dtype.
+    """
+    B, Tq, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    scale = jnp.float32(sm_scale if sm_scale is not None else D**-0.5)
+    qg = q.reshape(B, Tq, Hkv, G, D).astype(jnp.float32)
+
+    m = jnp.full((B, Hkv, G, Tq), _NEG, jnp.float32)
+    l = jnp.zeros((B, Hkv, G, Tq), jnp.float32)
+    o = jnp.zeros((B, Hkv, G, Tq, D), jnp.float32)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    # Local block first, then rotate-and-merge axis_size-1 times: a
+    # merge-then-rotate loop would end with a dead ppermute round (XLA
+    # can't DCE collectives inside the loop body).
+    m, l, o = _merge_block(qg, k, v, q_pos, kv_pos, m, l, o, scale)
+
+    def body(_, carry):
+        k_c, v_c, pos_c, m, l, o = carry
+        # Rotate while the current block's compute is queued: XLA
+        # overlaps the ppermute with the einsums on TPU.
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        pos_c = lax.ppermute(pos_c, axis_name, perm)
+        m, l, o = _merge_block(qg, k_c, v_c, q_pos, pos_c, m, l, o, scale)
+        return k_c, v_c, pos_c, m, l, o
+
+    *_, m, l, o = lax.fori_loop(
+        0, axis_size - 1, body, (k, v, kv_pos, m, l, o)
+    )
+    out = o / jnp.maximum(l, 1e-20)[..., None]  # zero rows stay zero
+    # [B,Hkv,G,Tq,D] -> [B,Tq,H,D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tq, H, D).astype(q.dtype)

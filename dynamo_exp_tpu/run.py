@@ -98,10 +98,18 @@ def build_tpu_engine(opts):
         has_weights = any(
             f.endswith(".safetensors") for f in os.listdir(opts.model_path)
         )
-        if has_weights and not opts.random_weights:
+        if opts.random_weights:
+            pass  # explicit opt-in: serve random weights (tests, smoke)
+        elif has_weights:
             from .models.loader import load_params
 
             params, mcfg = load_params(opts.model_path, mcfg)
+        else:
+            # Never silently serve garbage under a real model's name.
+            raise SystemExit(
+                f"no .safetensors weights in {opts.model_path}; "
+                "pass --random-weights to serve a random-initialized model"
+            )
     elif opts.preset:
         mcfg = PRESETS[opts.preset]
     else:
@@ -165,10 +173,27 @@ def require_mdc(opts):
     return mdc
 
 
+async def resolve_openai_engine(opts, drt, core, full, mdc):
+    """One place that turns (out=…, --model-path) into an OpenAI-level
+    engine. Returns (engine, mdc, kv_router_or_None); the caller stops
+    the router on shutdown."""
+    from .http import build_pipeline_engine
+
+    kv_router = None
+    if opts.output.startswith("dyn://"):
+        mdc = require_mdc(opts)
+        core, kv_router = await remote_core(opts, drt, mdc.kv_cache_block_size)
+    if core is not None:
+        if mdc is None:
+            mdc = require_mdc(opts)  # core engines need tokenizer/template
+        return build_pipeline_engine(mdc, core), mdc, kv_router
+    return full, mdc, kv_router
+
+
 # -------------------------------------------------------------------- inputs
 async def run_http(opts, drt, core, full, mdc):
     """OpenAI ingress (reference: input/http.rs + http/service)."""
-    from .http import HttpService, build_pipeline_engine
+    from .http import HttpService
     from .http.discovery import ModelWatcher
 
     svc = HttpService(host=opts.http_host, port=opts.http_port)
@@ -179,13 +204,10 @@ async def run_http(opts, drt, core, full, mdc):
         watcher = ModelWatcher(drt, svc.manager, router_mode(opts))
         await watcher.start()
     else:
-        if opts.output.startswith("dyn://"):
-            mdc = require_mdc(opts)
-            core, kv_router = await remote_core(opts, drt, mdc.kv_cache_block_size)
-        if core is not None and mdc is None:
-            mdc = require_mdc(opts)  # core engines need tokenizer/template
+        engine, mdc, kv_router = await resolve_openai_engine(
+            opts, drt, core, full, mdc
+        )
         name = (mdc.display_name if mdc else "") or opts.model_name or "default"
-        engine = build_pipeline_engine(mdc, core) if core is not None else full
         svc.manager.add_chat_model(name, engine)
         svc.manager.add_completion_model(name, engine)
     port = await svc.start()
@@ -362,7 +384,7 @@ async def run_batch(opts, drt, engine, mdc, path: str):
 
 # --------------------------------------------------------------------- main
 async def main_async(opts) -> None:
-    from .http import build_pipeline_engine
+
     from .runtime.component import DistributedRuntime
     from .runtime.config import RuntimeConfig
 
@@ -385,16 +407,9 @@ async def main_async(opts) -> None:
             await run_worker(opts, drt, core, tpu_engine)
             return
         # Local text-ish drivers need an OpenAI-level engine.
-        kv_router = None
-        if opts.output.startswith("dyn://"):
-            mdc = require_mdc(opts)
-            core, kv_router = await remote_core(opts, drt, mdc.kv_cache_block_size)
-        if core is not None:
-            if mdc is None:
-                mdc = require_mdc(opts)
-            engine = build_pipeline_engine(mdc, core)
-        else:
-            engine = full
+        engine, mdc, kv_router = await resolve_openai_engine(
+            opts, drt, core, full, mdc
+        )
         try:
             if opts.input == "text":
                 await run_text(opts, drt, engine, mdc)

@@ -1,0 +1,120 @@
+"""Ring attention + sequence-parallel prefill on the 8-device CPU mesh.
+
+A capability the reference lacks (SURVEY.md §5): context parallelism.
+Oracle = the dense/paged single-device paths already tested elsewhere.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dynamo_exp_tpu.models import TINY, forward, init_kv_cache, init_params
+from dynamo_exp_tpu.models.llama import forward_ring_prefill
+from dynamo_exp_tpu.ops.attention import dense_causal_attention
+from dynamo_exp_tpu.ops.ring_attention import ring_attention
+from dynamo_exp_tpu.parallel import build_mesh
+
+SP = 8
+
+
+def ring_mesh():
+    return build_mesh(sp=SP)
+
+
+def run_ring(mesh, q, k, v, q_pos, kv_pos):
+    seq4 = P(None, "sp", None, None)
+    seq2 = P(None, "sp")
+    fn = shard_map(
+        partial(ring_attention, axis_name="sp", axis_size=SP),
+        mesh=mesh,
+        in_specs=(seq4, seq4, seq4, seq2, seq2),
+        out_specs=seq4,
+        check_vma=False,
+    )
+    return fn(q, k, v, q_pos, kv_pos)
+
+
+def test_ring_matches_dense_gqa():
+    B, T, H, Hkv, D = 2, 64, 4, 2, 16
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, T, Hkv, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, T, Hkv, D), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    want = dense_causal_attention(q, k, v)
+    got = run_ring(ring_mesh(), q, k, v, pos, pos)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ring_handles_padding_rows():
+    """Trailing padding (pos = -1) must produce zeros and not perturb
+    valid rows."""
+    B, T, H, Hkv, D = 1, 32, 2, 2, 8
+    valid = 19  # not a multiple of the shard size
+    rs = np.random.RandomState(1)
+    q = jnp.asarray(rs.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, T, Hkv, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, T, Hkv, D), jnp.float32)
+    pos_np = np.full((B, T), -1, np.int32)
+    pos_np[:, :valid] = np.arange(valid)
+    pos = jnp.asarray(pos_np)
+    got = np.asarray(run_ring(ring_mesh(), q, k, v, pos, pos))
+    want = np.asarray(dense_causal_attention(q[:, :valid], k[:, :valid], v[:, :valid]))
+    np.testing.assert_allclose(got[:, :valid], want, atol=1e-5)
+    np.testing.assert_array_equal(got[:, valid:], 0.0)
+
+
+def test_ring_prefill_matches_paged_forward():
+    """Full-model sequence-parallel prefill == the paged single-device
+    forward, logits and KV both."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, dtype="float32")
+    T = 64
+    ps = 8
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(2)
+    tokens = jnp.asarray(rs.randint(3, cfg.vocab_size, size=(1, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+
+    # Oracle: paged forward on pages 0..T/ps-1.
+    k0, v0 = init_kv_cache(cfg, num_pages=T // ps, page_size=ps)
+    table = jnp.arange(T // ps, dtype=jnp.int32)[None, :]
+    want_logits, want_k, want_v = forward(
+        params, cfg, tokens, positions, table, k0, v0
+    )
+
+    mesh = ring_mesh()
+    got_logits, got_k, got_v = forward_ring_prefill(
+        params, cfg, tokens, positions, mesh
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), atol=2e-3, rtol=1e-3
+    )
+    # Ring K/V is [L, B, T, Hkv, D]; oracle pool is [L, P, ps, Hkv, D].
+    L, Pn, _, Hkv, D = np.asarray(want_k).shape
+    np.testing.assert_allclose(
+        np.asarray(got_k).reshape(L, Pn, ps, Hkv, D), np.asarray(want_k), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_v).reshape(L, Pn, ps, Hkv, D), np.asarray(want_v), atol=1e-5
+    )
+
+
+def test_ring_prefill_rejects_indivisible_seq():
+    cfg = TINY
+    mesh = ring_mesh()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="divisible"):
+        forward_ring_prefill(
+            params,
+            cfg,
+            jnp.zeros((1, 30), jnp.int32),
+            jnp.zeros((1, 30), jnp.int32),
+            mesh,
+        )
