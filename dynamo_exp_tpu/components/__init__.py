@@ -1,0 +1,2 @@
+"""Standalone operator-facing components (reference: ``components/``):
+the Prometheus metrics exporter and a mock worker for exercising it."""

@@ -1,0 +1,183 @@
+"""Standalone metrics exporter: worker load + KV hit rate → Prometheus.
+
+Capability parity with ``/root/reference/components/metrics/``
+(``src/lib.rs:80-167`` ``PrometheusMetricsCollector``): scrape a target
+component's ``ForwardPassMetrics`` from the stats plane, subscribe to
+``kv-hit-rate`` events, expose everything on ``/metrics`` for a
+Prometheus pull. Run standalone:
+
+    python -m dynamo_exp_tpu.components.metrics \
+        --coordinator HOST:PORT --component ns.comp [--port 9091]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+from aiohttp import web
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    generate_latest,
+)
+
+from ..http.metrics import CONTENT_TYPE_LATEST
+from ..kv_router.metrics_aggregator import KvMetricsAggregator
+from ..kv_router.protocols import KV_HIT_RATE_SUBJECT
+from ..runtime.component import Component
+
+logger = logging.getLogger(__name__)
+
+_GAUGES = (
+    ("request_active_slots", "Active request slots"),
+    ("request_total_slots", "Total request slots"),
+    ("kv_active_blocks", "Active KV blocks"),
+    ("kv_total_blocks", "Total KV blocks"),
+    ("num_requests_waiting", "Requests waiting for admission"),
+    ("gpu_cache_usage_perc", "Device KV cache usage fraction"),
+    ("gpu_prefix_cache_hit_rate", "Device prefix-cache hit rate"),
+)
+
+
+class MetricsService:
+    """Scrapes one component and serves /metrics."""
+
+    def __init__(
+        self,
+        component: Component,
+        host: str = "0.0.0.0",
+        port: int = 9091,
+        scrape_interval_s: float = 1.0,
+    ):
+        self.component = component
+        self.host = host
+        self.port = port
+        self.registry = CollectorRegistry()
+        self.gauges = {
+            name: Gauge(
+                f"llm_kv_{name}", help_, ["worker_id"], registry=self.registry
+            )
+            for name, help_ in _GAUGES
+        }
+        self.hit_events = Counter(
+            "llm_kv_hit_events_total",
+            "KV-aware routing decisions observed",
+            registry=self.registry,
+        )
+        self.hit_isl_blocks = Counter(
+            "llm_kv_hit_isl_blocks_total",
+            "Input blocks across routing decisions",
+            registry=self.registry,
+        )
+        self.hit_overlap_blocks = Counter(
+            "llm_kv_hit_overlap_blocks_total",
+            "Matched (cache-hit) blocks across routing decisions",
+            registry=self.registry,
+        )
+        self.aggregator = KvMetricsAggregator(component, scrape_interval_s)
+        self._hit_task: asyncio.Task | None = None
+        self._export_task: asyncio.Task | None = None
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> int:
+        await self.aggregator.start()
+        stream = await self.component.drt.event_plane.subscribe(KV_HIT_RATE_SUBJECT)
+
+        async def pump_hits():
+            async for event in stream:
+                self.hit_events.inc()
+                self.hit_isl_blocks.inc(max(event.get("isl_blocks", 0), 0))
+                self.hit_overlap_blocks.inc(max(event.get("overlap_blocks", 0), 0))
+
+        async def pump_gauges():
+            while True:
+                await self.aggregator.updated.wait()
+                self.aggregator.updated.clear()
+                seen = set()
+                for wid, m in self.aggregator.endpoints.metrics.items():
+                    seen.add(str(wid))
+                    for name, _ in _GAUGES:
+                        self.gauges[name].labels(worker_id=str(wid)).set(
+                            getattr(m, name)
+                        )
+                # Drop series for departed workers so dashboards don't
+                # show ghosts (reference clears on scrape too).
+                for name, _ in _GAUGES:
+                    g = self.gauges[name]
+                    for labels in list(g._metrics):
+                        if labels[0] not in seen:
+                            g.remove(*labels)
+
+        self._hit_task = asyncio.ensure_future(pump_hits())
+        self._export_task = asyncio.ensure_future(pump_gauges())
+
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in self._runner.sites:
+            srv = getattr(s, "_server", None)
+            if srv and srv.sockets:
+                self.port = srv.sockets[0].getsockname()[1]
+        logger.info("metrics exporter on %s:%d", self.host, self.port)
+        return self.port
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=generate_latest(self.registry), content_type="text/plain"
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+    async def stop(self) -> None:
+        for t in (self._hit_task, self._export_task):
+            if t is not None:
+                t.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await t
+        self._hit_task = self._export_task = None
+        await self.aggregator.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    from ..runtime.component import DistributedRuntime
+    from ..runtime.config import RuntimeConfig
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--component", required=True, help="namespace.component")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--scrape-interval", type=float, default=1.0)
+    args = p.parse_args()
+
+    async def run():
+        cfg = RuntimeConfig(coordinator_endpoint=args.coordinator)
+        drt = DistributedRuntime(config=cfg)
+        ns, _, comp = args.component.partition(".")
+        svc = MetricsService(
+            drt.namespace(ns).component(comp),
+            args.host,
+            args.port,
+            args.scrape_interval,
+        )
+        port = await svc.start()
+        print(f"metrics on http://{args.host}:{port}/metrics", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

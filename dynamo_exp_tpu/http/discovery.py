@@ -122,5 +122,10 @@ class ModelWatcher:
             ep, self.router_mode, mdc.kv_cache_block_size
         )
         if kv_router is not None:
+            # A retry after a partially-failed registration may rebuild
+            # the chain; stop the superseded router or it scrapes forever.
+            old = self._kv_routers.pop(entry.name, None)
+            if old is not None:
+                await old.stop()
             self._kv_routers[entry.name] = kv_router
         return build_pipeline_engine(mdc, core)

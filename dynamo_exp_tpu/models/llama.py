@@ -125,6 +125,36 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
+def _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend):
+    """One transformer layer, shared by the paged and ring paths.
+
+    ``attend(q, k, v) -> (attn_out, kv_extra)`` is the only thing that
+    differs between them; everything else (norms, projections, rope,
+    residuals, SwiGLU) must stay identical or prefill logits silently
+    diverge from decode.
+    """
+    B, T = x.shape[:2]
+    hd = cfg.head_dim_
+    h = rms_norm(x, lp["attn_norm"], eps)
+    q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, hd)
+    k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, hd)
+    v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, hd)
+    q = apply_rope(q, rope_pos, inv_freq)
+    k = apply_rope(k, rope_pos, inv_freq)
+    attn, kv_extra = attend(q, k, v)
+    x = x + attn.reshape(B, T, cfg.num_heads * hd) @ lp["wo"]
+    h = rms_norm(x, lp["mlp_norm"], eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, kv_extra
+
+
+def _final_logits(params, cfg, x, eps):
+    x = rms_norm(x, params["final_norm"], eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -157,42 +187,29 @@ def forward(
     offsets = safe_pos % ps
 
     x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    rope_pos = jnp.maximum(positions, 0)
 
     def layer(x, layer_in):
         lp, k_pool, v_pool = layer_in
-        h = rms_norm(x, lp["attn_norm"], eps)
-        q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, hd)
-        k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, hd)
-        v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, hd)
-        pos_for_rope = jnp.maximum(positions, 0)
-        q = apply_rope(q, pos_for_rope, inv_freq)
-        k = apply_rope(k, pos_for_rope, inv_freq)
 
-        k_pool, v_pool = write_kv_pages(
-            k_pool,
-            v_pool,
-            k.reshape(B * T, cfg.num_kv_heads, hd),
-            v.reshape(B * T, cfg.num_kv_heads, hd),
-            page_ids,
-            offsets,
-            valid,
-        )
-        attn = paged_attention(q, k_pool, v_pool, page_table, positions)
-        x = x + attn.reshape(B, T, cfg.num_heads * hd) @ lp["wo"]
+        def attend(q, k, v):
+            kp, vp = write_kv_pages(
+                k_pool,
+                v_pool,
+                k.reshape(B * T, cfg.num_kv_heads, hd),
+                v.reshape(B * T, cfg.num_kv_heads, hd),
+                page_ids,
+                offsets,
+                valid,
+            )
+            return paged_attention(q, kp, vp, page_table, positions), (kp, vp)
 
-        h = rms_norm(x, lp["mlp_norm"], eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-        return x, (k_pool, v_pool)
+        return _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], k_cache, v_cache)
     )
-
-    x = rms_norm(x, params["final_norm"], eps)
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
-    return logits, new_k, new_v
+    return _final_logits(params, cfg, x, eps), new_k, new_v
 
 
 def forward_ring_prefill(
@@ -209,13 +226,16 @@ def forward_ring_prefill(
     parallelism of its own): the sequence axis is sharded over ``sp``,
     every non-attention op is local, and attention rotates K/V blocks
     around the ring (``ops/ring_attention.py``). Peak per-device
-    activation memory scales 1/sp, so prefills longer than one chip's
+    *activation* memory scales 1/sp, so prefills longer than one chip's
     HBM limit become possible.
 
-    Params are replicated over ``sp`` (shard params over ``tp`` and keep
-    sp a separate axis). Returns (logits [B,T,V], k, v [L,B,T,Hkv,D]),
-    all sharded over T — the caller scatters K/V into its page pool or
-    hands them to the disaggregation transfer plane.
+    Params are fully **replicated** inside this path (``in_specs=P()``):
+    it is sequence-parallel only — the layer body has no psum, so
+    tp-sharded params would produce partial sums. Combining sp with tp
+    (tp-sharded projections + ring over sp) is a planned extension.
+    Returns (logits [B,T,V], k, v [L,B,T,Hkv,D]), all sharded over T —
+    the caller scatters K/V into its page pool or hands them to the
+    disaggregation transfer plane.
     """
     from functools import partial as _partial
 
@@ -244,26 +264,13 @@ def forward_ring_prefill(
         rope_pos = jnp.maximum(pos_l, 0)
 
         def layer(x, lp):
-            Bl, Tl = x.shape[:2]
-            h = rms_norm(x, lp["attn_norm"], eps)
-            q = (h @ lp["wq"]).reshape(Bl, Tl, cfg.num_heads, hd)
-            k = (h @ lp["wk"]).reshape(Bl, Tl, cfg.num_kv_heads, hd)
-            v = (h @ lp["wv"]).reshape(Bl, Tl, cfg.num_kv_heads, hd)
-            q = apply_rope(q, rope_pos, inv_freq)
-            k = apply_rope(k, rope_pos, inv_freq)
-            attn = ring_attention(q, k, v, pos_l, pos_l, sp_axis, sp)
-            x = x + attn.reshape(Bl, Tl, cfg.num_heads * hd) @ lp["wo"]
-            h = rms_norm(x, lp["mlp_norm"], eps)
-            gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-            return x, (k, v)
+            def attend(q, k, v):
+                attn = ring_attention(q, k, v, pos_l, pos_l, sp_axis, sp)
+                return attn, (k, v)
+
+            return _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend)
 
         x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
-        x = rms_norm(x, params["final_norm"], eps)
-        head = (
-            params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-        )
-        logits = (x @ head).astype(jnp.float32)
-        return logits, ks, vs
+        return _final_logits(params, cfg, x, eps), ks, vs
 
     return fwd(params, tokens, positions)

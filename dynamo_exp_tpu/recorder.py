@@ -1,0 +1,106 @@
+"""JSONL event recorder with rotation, plus replay.
+
+Capability parity with ``/root/reference/lib/llm/src/recorder.rs:26-674``
+(generic JSONL recorder with file rotation and a ``Recorder<T>`` replay)
+and ``kv_router/recorder.rs`` (``KvRecorder`` taps the router-event
+stream for offline analysis / index rebuilds).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Any, AsyncIterator, Iterator
+
+logger = logging.getLogger(__name__)
+
+
+class Recorder:
+    """Append-only JSONL event log; rotates at ``max_bytes`` keeping up to
+    ``max_files`` older generations (``path``, ``path.1``, ``path.2``…)."""
+
+    def __init__(self, path: str, max_bytes: int = 64 << 20, max_files: int = 4):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.max_files = max_files
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+        self.count = 0
+
+    def record(self, event: Any, ts: float | None = None) -> None:
+        line = json.dumps({"ts": ts if ts is not None else time.time(), "event": event})
+        self._fh.write(line + "\n")
+        self._fh.flush()
+        self.count += 1
+        if self._fh.tell() >= self.max_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        oldest = f"{self.path}.{self.max_files}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_files - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    @staticmethod
+    def replay(path: str) -> Iterator[tuple[float, Any]]:
+        """Yield (ts, event) from one JSONL file, oldest line first.
+        Corrupt lines (e.g. a torn write at crash) are skipped."""
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    d = json.loads(line)
+                    yield float(d["ts"]), d["event"]
+                except (ValueError, KeyError):
+                    logger.warning("skipping corrupt recorder line")
+
+
+class KvRecorder:
+    """Taps a KV-router event subject into a Recorder, and replays a
+    recording into an indexer — rebuild-from-log, the reference's
+    ``KvRecorder`` capability (``kv_router/recorder.rs``)."""
+
+    def __init__(self, recorder: Recorder):
+        self.recorder = recorder
+        self._task: asyncio.Task | None = None
+
+    async def start(self, event_plane, subject: str) -> None:
+        stream = await event_plane.subscribe(subject)
+
+        async def pump(stream: AsyncIterator[dict]) -> None:
+            async for event in stream:
+                self.recorder.record(event)
+
+        self._task = asyncio.ensure_future(pump(stream))
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+        self.recorder.close()
+
+    @staticmethod
+    def replay_into(path: str, indexer) -> int:
+        """Feed a recording's RouterEvents into a KvIndexer; returns the
+        number of events applied."""
+        from .kv_router.protocols import RouterEvent
+
+        n = 0
+        for _ts, event in Recorder.replay(path):
+            indexer.apply(RouterEvent.from_dict(event))
+            n += 1
+        return n

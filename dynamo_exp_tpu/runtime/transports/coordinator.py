@@ -437,6 +437,21 @@ class CoordinatorClient:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
+            # Watch consumers block on queue.get(); without a sentinel
+            # they would hang forever on a dead connection instead of
+            # seeing an error they can retry on.
+            for q in self._watch_queues.values():
+                q.put_nowait(_CONN_LOST)
+            self._watch_queues.clear()
+
+    @property
+    def is_alive(self) -> bool:
+        return (
+            self._writer is not None
+            and self._reader_task is not None
+            and not self._reader_task.done()
+            and not self._closed
+        )
 
     async def call(
         self, op: str, header: dict | None = None, payload: bytes = b""
@@ -505,6 +520,10 @@ class CoordinatorError(RuntimeError):
     pass
 
 
+# Sentinel pushed into watch queues when the connection dies.
+_CONN_LOST = {"__conn_lost__": True}
+
+
 # --------------------------------------------------------------------------
 # Plane adapters
 # --------------------------------------------------------------------------
@@ -535,6 +554,7 @@ class CoordinatorDiscovery(Discovery):
     """Discovery over the coordinator (etcd-equivalent semantics)."""
 
     def __init__(self, endpoint: str, lease_ttl_s: float = 10.0):
+        self.endpoint = endpoint
         self.client = CoordinatorClient(endpoint)
         self.lease_ttl_s = lease_ttl_s
         self._connected = False
@@ -547,6 +567,14 @@ class CoordinatorDiscovery(Discovery):
         if self._connect_lock is None:
             self._connect_lock = asyncio.Lock()
         async with self._connect_lock:
+            if self._connected and not self.client.is_alive:
+                # Connection died (coordinator restart): retrying callers
+                # get a fresh socket instead of the dead client forever.
+                # Leases/watches on the old connection are gone — callers
+                # re-establish what they need (watch loops re-watch).
+                await self.client.close()
+                self.client = CoordinatorClient(self.endpoint)
+                self._connected = False
             if not self._connected:
                 await self.client.connect()
                 self._connected = True
@@ -596,6 +624,8 @@ class CoordinatorDiscovery(Discovery):
         try:
             while True:
                 h = await q.get()
+                if h.get("__conn_lost__"):
+                    raise ConnectionError("coordinator connection lost")
                 yield [InstanceInfo.from_dict(d) for d in h["instances"]]
         finally:
             await c.stop_watch(wid)
@@ -640,6 +670,8 @@ class CoordinatorDiscovery(Discovery):
         try:
             while True:
                 h = await q.get()
+                if h.get("__conn_lost__"):
+                    raise ConnectionError("coordinator connection lost")
                 yield {k: _unb64(v) for k, v in h["entries"].items()}
         finally:
             await c.stop_watch(wid)
@@ -671,6 +703,8 @@ class CoordinatorEventPlane(EventPlane):
             try:
                 while True:
                     h = await q.get()
+                    if h.get("__conn_lost__"):
+                        raise ConnectionError("coordinator connection lost")
                     yield h["event"]
             finally:
                 await c.stop_watch(wid)

@@ -161,6 +161,27 @@ async def test_kv_create_and_watch():
 
 # --- events / queue / object store --------------------------------------
 @pytest.mark.asyncio
+async def test_watch_fails_fast_on_connection_loss_and_client_reconnects():
+    """A dead coordinator connection must surface as ConnectionError on
+    watch streams (not hang), and the next RPC must get a fresh socket."""
+    async with coordinator_pair() as (server, d):
+        await d.kv_put("reconnect/a", b"1")
+        gen = d.kv_watch_prefix("reconnect/")
+        first = await asyncio.wait_for(anext(gen), 5)
+        assert first == {"reconnect/a": b"1"}
+        # Simulate network drop: kill the client's socket out from under it.
+        d.client._writer.close()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(anext(gen), 5)
+        # Next call transparently reconnects (server is still up).
+        await d.kv_put("reconnect/b", b"2")
+        assert await d.kv_get("reconnect/b") == b"2"
+        # And a new watch works on the fresh connection.
+        gen2 = d.kv_watch_prefix("reconnect/")
+        snap = await asyncio.wait_for(anext(gen2), 5)
+        assert snap.get("reconnect/b") == b"2"
+
+
 async def test_event_pub_sub_wildcard():
     async with coordinator_pair() as (_, discovery):
         plane = CoordinatorEventPlane(discovery)
