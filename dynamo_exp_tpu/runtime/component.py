@@ -192,12 +192,15 @@ class Endpoint:
         drt = self.drt
         if lease is None:
             lease = await drt.primary_lease()
-        # Instance ids are unique per served endpoint (NOT the lease id):
-        # one process commonly serves several endpoints under one primary
-        # lease, and they must not clobber each other in the registry.
+        # Instance ids must be unique ACROSS processes (the registry and
+        # direct routing key on them), so derive them from the lease id —
+        # globally unique per coordinator — plus a per-process counter
+        # for the several endpoints one process serves under one primary
+        # lease. A bare per-process counter would make every worker
+        # process claim instance 1 and clobber its peers in discovery.
         info = InstanceInfo(
             address=self.address,
-            instance_id=next_instance_id(),
+            instance_id=lease.lease_id * 10_000 + next_instance_id(),
             metadata=metadata or {},
         )
         served = await drt.request_plane.serve(info, handler, stats_handler)

@@ -1,0 +1,36 @@
+"""Self-contained service SDK: decorate classes, link them into graphs,
+serve each component as its own process.
+
+Capability parity with the reference's BentoML-derived SDK
+(``/root/reference/deploy/dynamo/sdk/`` — ``@service``,
+``@dynamo_endpoint``, ``depends()``, ``dynamo_context``, YAML
+``ServiceConfig``, ``dynamo serve`` with per-service circus watchers and
+GPU allocation), rebuilt without the BentoML dependency (SURVEY.md §7
+"what we do NOT port") and with TPU-chip allocation instead of
+``CUDA_VISIBLE_DEVICES``.
+"""
+
+from .config import ServiceConfig
+from .dependency import DependencyClient, depends
+from .service import (
+    async_on_start,
+    dynamo_context,
+    endpoint,
+    get_spec,
+    service,
+)
+
+# The reference names this decorator dynamo_endpoint; keep both spellings.
+dynamo_endpoint = endpoint
+
+__all__ = [
+    "service",
+    "endpoint",
+    "dynamo_endpoint",
+    "async_on_start",
+    "depends",
+    "DependencyClient",
+    "dynamo_context",
+    "ServiceConfig",
+    "get_spec",
+]

@@ -1,0 +1,41 @@
+"""TPU chip allocation for multi-service hosts.
+
+Reference parity: ``deploy/dynamo/sdk/cli/allocator.py:53-120``
+(``ResourceAllocator.assign_gpus`` → ``CUDA_VISIBLE_DEVICES`` per
+watcher). TPU equivalent: disjoint chip sets per service process via
+``TPU_VISIBLE_CHIPS`` (libtpu) — also exported as
+``TPU_VISIBLE_DEVICES`` for older runtimes. A service asks with
+``resources={"tpu": n}``; services with no tpu request get no chips and
+the TPU runtime is told to stay off (``JAX_PLATFORMS=cpu``), so
+frontends/routers never grab the accelerator.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+class TPUAllocator:
+    def __init__(self, total_chips: int | None = None):
+        if total_chips is None:
+            total_chips = int(os.environ.get("DYN_TPU_CHIPS", "4"))
+        self.total_chips = total_chips
+        self._next = 0
+
+    def assign(self, service_name: str, chips: int) -> dict[str, str]:
+        """Env vars for one worker process of ``service_name``."""
+        if chips <= 0:
+            # Host-side service: keep JAX off the TPU entirely.
+            return {"JAX_PLATFORMS": "cpu"}
+        if self._next + chips > self.total_chips:
+            raise AllocationError(
+                f"{service_name} wants {chips} TPU chips but only "
+                f"{self.total_chips - self._next} of {self.total_chips} remain"
+            )
+        ids = ",".join(str(i) for i in range(self._next, self._next + chips))
+        self._next += chips
+        return {"TPU_VISIBLE_CHIPS": ids, "TPU_VISIBLE_DEVICES": ids}

@@ -1,0 +1,49 @@
+"""ServiceConfig: YAML per-service configuration, env-overridable.
+
+Reference parity: ``deploy/dynamo/sdk/lib/config.py:1-105`` — a YAML
+file (``-f configs/agg.yaml``) whose top-level keys are service names,
+merged with the ``DYN_SERVICE_CONFIG`` env var (JSON), handed to each
+service as constructor kwargs / attribute defaults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+ENV_VAR = "DYN_SERVICE_CONFIG"
+
+
+class ServiceConfig:
+    def __init__(self, data: dict[str, dict[str, Any]] | None = None):
+        self.data = data or {}
+
+    @classmethod
+    def load(cls, path: str | None = None) -> "ServiceConfig":
+        data: dict[str, dict[str, Any]] = {}
+        if path:
+            import yaml
+
+            with open(path) as f:
+                data.update(yaml.safe_load(f) or {})
+        env = os.environ.get(ENV_VAR)
+        if env:
+            for svc, overrides in json.loads(env).items():
+                data.setdefault(svc, {}).update(overrides)
+        return cls(data)
+
+    def get(self, service_name: str) -> dict[str, Any]:
+        return dict(self.data.get(service_name, {}))
+
+    def dumps(self) -> str:
+        """Serialized form passed to child processes via the env var, so
+        every service process sees the same merged view."""
+        return json.dumps(self.data)
+
+    def apply_to(self, instance: Any, service_name: str) -> None:
+        """Set config keys as attributes on a service instance (the
+        reference explodes them into per-service CLI args; attributes
+        keep the same reach-from-anywhere behavior without argparse)."""
+        for key, value in self.get(service_name).items():
+            setattr(instance, key, value)

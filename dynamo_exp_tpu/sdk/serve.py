@@ -1,0 +1,171 @@
+"""Graph supervisor: one process per service worker, TPU chips allocated.
+
+Reference parity: ``deploy/dynamo/sdk/cli/serving.py:58-187`` (circus
+arbiter with one watcher per service, GPU allocation, per-watcher env) —
+rebuilt on plain subprocesses with restart-with-backoff.
+
+    python -m dynamo_exp_tpu.sdk.serve pkg.module:RootClass \
+        [-f config.yaml] [--coordinator HOST:PORT | --start-coordinator] \
+        [--service-name OnlyThisOne] [--tpu-chips N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import logging
+import os
+import signal
+import sys
+import time
+
+logger = logging.getLogger("dynamo_exp_tpu.sdk.serve")
+
+MAX_RESTARTS = 3
+RESTART_WINDOW_S = 60.0
+
+
+class Watcher:
+    """One service worker process, restarted on unexpected death."""
+
+    def __init__(self, spec, worker_idx: int, argv: list[str], env: dict[str, str]):
+        self.spec = spec
+        self.worker_idx = worker_idx
+        self.argv = argv
+        self.env = env
+        self.proc: asyncio.subprocess.Process | None = None
+        self.restarts: list[float] = []
+        self.stopping = False
+
+    @property
+    def name(self) -> str:
+        return f"{self.spec.name}[{self.worker_idx}]"
+
+    async def start(self) -> None:
+        self.proc = await asyncio.create_subprocess_exec(
+            *self.argv, env={**os.environ, **self.env}
+        )
+        logger.info("started %s (pid %d)", self.name, self.proc.pid)
+
+    async def supervise(self) -> None:
+        while not self.stopping:
+            rc = await self.proc.wait()
+            if self.stopping:
+                return
+            now = time.monotonic()
+            self.restarts = [
+                t for t in self.restarts if now - t < RESTART_WINDOW_S
+            ] + [now]
+            if len(self.restarts) > MAX_RESTARTS:
+                raise RuntimeError(
+                    f"{self.name} crashed {len(self.restarts)} times in "
+                    f"{RESTART_WINDOW_S:.0f}s (rc={rc}); giving up"
+                )
+            logger.warning("%s exited rc=%s; restarting", self.name, rc)
+            await asyncio.sleep(min(2 ** (len(self.restarts) - 1), 10))
+            await self.start()
+
+    async def stop(self, timeout: float = 20.0) -> None:
+        self.stopping = True
+        if self.proc is None or self.proc.returncode is not None:
+            return
+        self.proc.terminate()  # SIGTERM -> graceful drain in the child
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(self.proc.wait(), timeout)
+        if self.proc.returncode is None:
+            self.proc.kill()
+            await self.proc.wait()
+
+
+async def serve_graph(args) -> None:
+    from ..runtime.transports.coordinator import CoordinatorServer
+    from .allocator import TPUAllocator
+    from .config import ENV_VAR, ServiceConfig
+    from .serve_service import load_target
+    from .service import discover_graph
+
+    root = load_target(args.target)
+    graph = discover_graph(root)
+    if args.service_name:
+        graph = [s for s in graph if s.name == args.service_name]
+        if not graph:
+            raise SystemExit(f"no service named {args.service_name!r}")
+
+    coordinator = None
+    endpoint = args.coordinator
+    if args.start_coordinator:
+        coordinator = CoordinatorServer("127.0.0.1", args.coordinator_port)
+        await coordinator.start()
+        endpoint = coordinator.address
+        print(f"coordinator on {endpoint}", flush=True)
+    if not endpoint:
+        raise SystemExit("need --coordinator or --start-coordinator")
+
+    config = ServiceConfig.load(args.config)
+    allocator = TPUAllocator(args.tpu_chips)
+    watchers: list[Watcher] = []
+    for spec in graph:
+        for w in range(spec.workers):
+            env = {
+                "DYN_RUNTIME_COORDINATOR_ENDPOINT": endpoint,
+                ENV_VAR: config.dumps(),
+                **allocator.assign(spec.name, int(spec.resources.get("tpu", 0))),
+            }
+            argv = [
+                sys.executable,
+                "-m",
+                "dynamo_exp_tpu.sdk.serve_service",
+                args.target,
+                "--service-name",
+                spec.name,
+            ]
+            watchers.append(Watcher(spec, w, argv, env))
+
+    for w in watchers:
+        await w.start()
+    print(f"serving {len(watchers)} workers: "
+          f"{[w.name for w in watchers]}", flush=True)
+    tasks = [asyncio.ensure_future(w.supervise()) for w in watchers]
+    try:
+        done, _ = await asyncio.wait(tasks, return_when=asyncio.FIRST_EXCEPTION)
+        for t in done:
+            t.result()  # propagate give-up errors
+    finally:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(
+            *[w.stop() for w in watchers], return_exceptions=True
+        )
+        if coordinator is not None:
+            await coordinator.close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("target", help="pkg.module:RootClass")
+    p.add_argument("-f", "--config", default=None, help="service config YAML")
+    p.add_argument("--coordinator", default=os.environ.get("DYN_COORDINATOR", ""))
+    p.add_argument("--start-coordinator", action="store_true")
+    p.add_argument("--coordinator-port", type=int, default=0)
+    p.add_argument("--service-name", default=None, help="run one service only")
+    p.add_argument("--tpu-chips", type=int, default=None,
+                   help="host chip budget (default: env DYN_TPU_CHIPS or 4)")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    loop = asyncio.new_event_loop()
+    task = loop.create_task(serve_graph(args))
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError, ValueError):
+            loop.add_signal_handler(sig, task.cancel)
+    try:
+        loop.run_until_complete(task)
+    except asyncio.CancelledError:
+        pass
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,126 @@
+"""Per-process service entry: run ONE service of a graph.
+
+Reference parity: ``deploy/dynamo/sdk/cli/serve_dynamo.py:120-367`` —
+each circus watcher runs this module for its service: build the
+DistributedRuntime, create the component, resolve ``depends()`` edges,
+run ``@async_on_start`` hooks, then serve every ``@endpoint``.
+
+    python -m dynamo_exp_tpu.sdk.serve_service pkg.module:RootClass \
+        --service-name Middle [--config cfg.yaml]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+import importlib
+import logging
+import signal
+import sys
+
+logger = logging.getLogger("dynamo_exp_tpu.sdk.serve_service")
+
+
+def load_target(target: str) -> type:
+    mod_name, _, cls_name = target.partition(":")
+    if not cls_name:
+        raise SystemExit(f"target must be module:Class, got {target!r}")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, cls_name)
+
+
+async def run_service(target: str, service_name: str | None, config_path: str | None):
+    from ..runtime.component import DistributedRuntime
+    from ..runtime.engine import AsyncEngineContext
+    from ..runtime.annotated import Annotated
+    from .config import ServiceConfig
+    from .dependency import depends as depends_t
+    from .service import discover_graph, dynamo_context
+
+    root = load_target(target)
+    graph = discover_graph(root)
+    spec = next(
+        (s for s in graph if s.name == (service_name or graph[-1].name)), None
+    )
+    if spec is None:
+        raise SystemExit(
+            f"service {service_name!r} not in graph "
+            f"({[s.name for s in graph]})"
+        )
+
+    drt = DistributedRuntime.from_settings()
+    component = drt.namespace(spec.namespace).component(spec.component_name)
+    dynamo_context.update(
+        runtime=drt,
+        namespace=spec.namespace,
+        component=component,
+        endpoints=sorted(spec.endpoints),
+        instance_ids={},
+    )
+
+    instance = spec.cls()
+    ServiceConfig.load(config_path).apply_to(instance, spec.name)
+
+    # Resolve graph edges to live clients before user startup hooks run.
+    for dep in vars(spec.cls).values():
+        if isinstance(dep, depends_t):
+            await dep.resolve(drt)
+    for hook in spec.on_start:
+        await getattr(instance, hook)()
+
+    served = []
+    for ep_name in sorted(spec.endpoints):
+        bound = getattr(instance, spec.endpoints[ep_name].__name__)
+
+        def make_handler(fn):
+            async def handler(request: dict, context: AsyncEngineContext):
+                try:
+                    async for item in fn(request):
+                        yield Annotated.from_data(item).to_dict()
+                except Exception as e:  # error frames travel in-band
+                    logger.exception("endpoint handler failed")
+                    yield Annotated.from_error(str(e)).to_dict()
+
+            return handler
+
+        s = await component.endpoint(ep_name).serve_endpoint(make_handler(bound))
+        dynamo_context["instance_ids"][ep_name] = s.instance_id
+        served.append(s)
+
+    print(f"service {spec.name} ready ({len(served)} endpoints)", flush=True)
+    try:
+        await drt.runtime.primary_token.cancelled()
+    finally:
+        for s in served:
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(s.close(), 15)
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(drt.close(), 15)
+
+
+def main(argv: list[str] | None = None) -> None:
+    logging.basicConfig(level="INFO")
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("target", help="pkg.module:RootClass")
+    p.add_argument("--service-name", default=None)
+    p.add_argument("--config", default=None)
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    loop = asyncio.new_event_loop()
+    task = loop.create_task(
+        run_service(args.target, args.service_name, args.config)
+    )
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        with contextlib.suppress(NotImplementedError, ValueError):
+            loop.add_signal_handler(sig, task.cancel)
+    try:
+        loop.run_until_complete(task)
+    except asyncio.CancelledError:
+        pass
+    finally:
+        loop.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,128 @@
+"""Service decorators and the per-process runtime context.
+
+Reference parity: ``deploy/dynamo/sdk/lib/service.py:37-348`` (the
+``@service`` class decorator + ``DynamoService``), ``decorators.py:26-90``
+(``@dynamo_endpoint``, ``@async_on_start``), and the ``dynamo_context``
+global populated by ``serve_dynamo.py:120-367``.
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+# Populated by serve_service before the service class is instantiated:
+# {"runtime": DistributedRuntime, "component": Component, "namespace": str,
+#  "endpoints": [names], "instance_ids": {endpoint: id}}
+dynamo_context: dict[str, Any] = {}
+
+
+@dataclass
+class ServiceSpec:
+    """Everything the supervisor needs to launch one service class."""
+
+    cls: type
+    name: str
+    namespace: str = "dynamo"
+    workers: int = 1
+    # Resource request, e.g. {"tpu": 4} chips or {"cpu": "2", "memory": "2Gi"}.
+    resources: dict[str, Any] = field(default_factory=dict)
+    enabled: bool = True  # dynamo disabled = plain local object (reference)
+    endpoints: dict[str, Callable] = field(default_factory=dict)
+    on_start: list[str] = field(default_factory=list)
+
+    @property
+    def component_name(self) -> str:
+        return self.name
+
+
+def service(
+    dynamo: dict | None = None,
+    resources: dict | None = None,
+    workers: int = 1,
+    name: str | None = None,
+):
+    """Class decorator registering a service.
+
+    ``@service(dynamo={"namespace": "ns"}, resources={"tpu": 1}, workers=2)``
+    """
+
+    def wrap(cls: type) -> type:
+        dyn = dynamo or {}
+        spec = ServiceSpec(
+            cls=cls,
+            name=name or cls.__name__,
+            namespace=dyn.get("namespace", "dynamo"),
+            workers=workers,
+            resources=resources or {},
+            enabled=dyn.get("enabled", True),
+        )
+        for attr, val in inspect.getmembers(cls):
+            ep_name = getattr(val, "__dynamo_endpoint__", None)
+            if ep_name is not None:
+                spec.endpoints[ep_name] = val
+            if getattr(val, "__dynamo_on_start__", False):
+                spec.on_start.append(attr)
+        cls.__dynamo_spec__ = spec
+        return cls
+
+    return wrap
+
+
+def endpoint(name: str | None = None):
+    """Mark an async-generator method as a served endpoint.
+
+    The method signature is ``async def gen(self, request: dict)`` yielding
+    response dicts; the serving layer wraps frames into the Annotated
+    envelope (reference: ``@dynamo_endpoint``, ``decorators.py:26-60``).
+    """
+
+    def wrap(fn):
+        fn.__dynamo_endpoint__ = name or fn.__name__
+        return fn
+
+    # Allow bare usage: @endpoint
+    if callable(name):
+        fn, name = name, None
+        return wrap(fn)
+    return wrap
+
+
+def async_on_start(fn):
+    """Run after the runtime context exists, before endpoints serve
+    (reference: ``@async_on_start``)."""
+    fn.__dynamo_on_start__ = True
+    return fn
+
+
+def get_spec(cls: type) -> ServiceSpec:
+    spec = getattr(cls, "__dynamo_spec__", None)
+    if spec is None:
+        raise TypeError(f"{cls.__name__} is not decorated with @service")
+    return spec
+
+
+def discover_graph(root: type) -> list[ServiceSpec]:
+    """The dependency closure of ``root``, dependencies first.
+
+    Reference: graphs link services via ``depends()`` class attributes
+    (``examples/llm/graphs/agg.py``); the serve CLI launches every
+    service in the closure.
+    """
+    from .dependency import depends as _depends
+
+    order: list[ServiceSpec] = []
+    seen: set[type] = set()
+
+    def visit(cls: type) -> None:
+        if cls in seen:
+            return
+        seen.add(cls)
+        for dep in vars(cls).values():
+            if isinstance(dep, _depends):
+                visit(dep.target)
+        order.append(get_spec(cls))
+
+    visit(root)
+    return order

@@ -1,0 +1,49 @@
+"""Minimal 3-stage SDK pipeline: Frontend -> Middle -> Backend.
+
+Reference parity: ``/root/reference/examples/hello_world/hello_world.py``
+(:28-75) — no accelerator, pure control-plane plumbing. Each stage
+decorates the text and streams it on. Serve with:
+
+    python -m dynamo_exp_tpu.sdk.serve \
+        examples.hello_world.hello_world:Frontend --start-coordinator
+"""
+
+from dynamo_exp_tpu.sdk import depends, endpoint, service
+
+
+@service(dynamo={"namespace": "hello"})
+class Backend:
+    """Generates tokens from the (twice-decorated) request text."""
+
+    @endpoint()
+    async def generate(self, request: dict):
+        text = request.get("text", "")
+        for word in f"{text}-back".split(","):
+            yield {"token": word}
+
+
+@service(dynamo={"namespace": "hello"})
+class Middle:
+    backend = depends(Backend)
+
+    @endpoint()
+    async def generate(self, request: dict):
+        text = request.get("text", "")
+        stream = await self.backend.generate({"text": f"{text}-mid"})
+        async for item in stream:
+            yield item
+
+
+@service(dynamo={"namespace": "hello"})
+class Frontend:
+    middle = depends(Middle)
+
+    # Configurable via ServiceConfig YAML ({"Frontend": {"greeting": ...}}).
+    greeting = "hello"
+
+    @endpoint()
+    async def generate(self, request: dict):
+        text = f"{self.greeting},{request.get('text', '')}"
+        stream = await self.middle.generate({"text": text})
+        async for item in stream:
+            yield item
