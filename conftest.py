@@ -17,6 +17,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Subprocesses the tests spawn (proc workers, SDK supervisors) must not
+# register accelerator PJRT plugins: the image's sitecustomize (on
+# PYTHONPATH) dials a remote TPU tunnel at interpreter startup, which
+# can block a pure-CPU child indefinitely when the tunnel is busy.
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if p and "axon" not in p
+)
 
 # The image's sitecustomize registers the TPU-tunnel backend and makes it
 # the default regardless of env vars; override at the config level too so

@@ -9,12 +9,11 @@ capabilities of NVIDIA Dynamo (the reference at ``rmukhopa/dynamo_exp``):
 - tokenization / chat-templating preprocessor and incremental detokenizing
   backend with stop-condition handling
 - a native JAX/TPU inference engine: continuous batching, paged KV cache in
-  HBM, Pallas paged-attention kernels, pjit/shard_map parallelism over a
-  device mesh
+  HBM (Pallas ragged-paged-attention on TPU, XLA reference path on CPU),
+  pjit/shard_map parallelism over a device mesh
 - KV block manager with prefix reuse and host-memory offload tiers
-- KV-cache-aware routing (radix indexer + cost-based scheduler)
+- KV-cache-aware routing (chained-hash indexer + cost-based scheduler)
 - disaggregated prefill/decode with queue-based prefill handoff
-- planner for dynamic worker scaling
 
 The reference is Rust/CUDA/torch; this framework is an independent,
 idiomatic JAX/TPU design, not a translation.

@@ -84,15 +84,45 @@ class MetricsService:
 
     async def start(self) -> int:
         await self.aggregator.start()
-        stream = await self.component.drt.event_plane.subscribe(KV_HIT_RATE_SUBJECT)
+        # Subscribe before returning so events published right after
+        # start() are counted.
+        stream = await self.component.drt.event_plane.subscribe(
+            KV_HIT_RATE_SUBJECT
+        )
 
-        async def pump_hits():
-            async for event in stream:
-                self.hit_events.inc()
-                self.hit_isl_blocks.inc(max(event.get("isl_blocks", 0), 0))
-                self.hit_overlap_blocks.inc(max(event.get("overlap_blocks", 0), 0))
+        async def pump_hits(stream):
+            # Re-subscribe on connection loss: a dead event stream must
+            # not silently freeze the hit-rate counters forever. A dead
+            # generator is never re-iterated: each drain failure
+            # discards the stream and retries the subscribe until it
+            # succeeds.
+            while True:
+                try:
+                    async for event in stream:
+                        self.hit_events.inc()
+                        self.hit_isl_blocks.inc(max(event.get("isl_blocks", 0), 0))
+                        self.hit_overlap_blocks.inc(
+                            max(event.get("overlap_blocks", 0), 0)
+                        )
+                    return
+                except asyncio.CancelledError:
+                    return
+                except Exception as exc:
+                    logger.warning("hit-event stream lost (%s); retrying", exc)
+                stream = None
+                while stream is None:
+                    await asyncio.sleep(1.0)
+                    try:
+                        stream = await self.component.drt.event_plane.subscribe(
+                            KV_HIT_RATE_SUBJECT
+                        )
+                    except asyncio.CancelledError:
+                        return
+                    except Exception:
+                        pass
 
         async def pump_gauges():
+            exported: set[str] = set()  # worker_ids with live series
             while True:
                 await self.aggregator.updated.wait()
                 self.aggregator.updated.clear()
@@ -104,14 +134,16 @@ class MetricsService:
                             getattr(m, name)
                         )
                 # Drop series for departed workers so dashboards don't
-                # show ghosts (reference clears on scrape too).
-                for name, _ in _GAUGES:
-                    g = self.gauges[name]
-                    for labels in list(g._metrics):
-                        if labels[0] not in seen:
-                            g.remove(*labels)
+                # show ghosts (reference clears on scrape too). Track our
+                # own exported set rather than walking prometheus_client
+                # internals.
+                for wid in exported - seen:
+                    for name, _ in _GAUGES:
+                        with contextlib.suppress(KeyError):
+                            self.gauges[name].remove(wid)
+                exported = seen
 
-        self._hit_task = asyncio.ensure_future(pump_hits())
+        self._hit_task = asyncio.ensure_future(pump_hits(stream))
         self._export_task = asyncio.ensure_future(pump_gauges())
 
         app = web.Application()
@@ -128,8 +160,13 @@ class MetricsService:
         return self.port
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        # CONTENT_TYPE_LATEST is e.g. "text/plain; version=0.0.4;
+        # charset=utf-8" — aiohttp wants content_type and charset split.
+        ctype, _, _ = CONTENT_TYPE_LATEST.partition(";")
         return web.Response(
-            body=generate_latest(self.registry), content_type="text/plain"
+            body=generate_latest(self.registry),
+            content_type=ctype.strip(),
+            charset="utf-8",
         )
 
     def render(self) -> bytes:

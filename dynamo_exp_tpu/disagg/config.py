@@ -84,17 +84,24 @@ class DisaggConfigWatcher:
         await self._discovery.kv_put(self._key, config.to_bytes())
 
     async def _follow(self) -> None:
-        try:
-            async for snapshot in self._discovery.kv_watch_prefix(self._key):
-                raw = snapshot.get(self._key)
-                if raw:
-                    try:
-                        self._config = DisaggConfig.from_bytes(raw)
-                        logger.info("disagg config updated: %s", self._config)
-                    except (ValueError, TypeError, KeyError):
-                        logger.warning("ignoring malformed disagg config")
-        except asyncio.CancelledError:
-            pass
+        # The watch raises (e.g. ConnectionError) when the coordinator
+        # connection drops; without the retry loop the live-reconfig
+        # feature would silently freeze at its last value forever.
+        while True:
+            try:
+                async for snapshot in self._discovery.kv_watch_prefix(self._key):
+                    raw = snapshot.get(self._key)
+                    if raw:
+                        try:
+                            self._config = DisaggConfig.from_bytes(raw)
+                            logger.info("disagg config updated: %s", self._config)
+                        except (ValueError, TypeError, KeyError):
+                            logger.warning("ignoring malformed disagg config")
+            except asyncio.CancelledError:
+                return
+            except Exception as exc:
+                logger.warning("disagg config watch lost (%s); retrying", exc)
+                await asyncio.sleep(1.0)
 
     async def close(self) -> None:
         if self._task is not None:

@@ -91,7 +91,7 @@ class DisaggDecodeEngine(AsyncEngine):
             first_token, pages = await asyncio.wait_for(
                 fut, timeout=self.transfer_timeout_s
             )
-            self._check_page_shapes(pages)
+            self._check_page_shapes(pages, len(binput.token_ids))
             self.remote_prefills += 1
             return RemoteKv(first_token=first_token, pages=pages)
         except Exception:  # noqa: BLE001 - remote prefill is best-effort
@@ -100,10 +100,14 @@ class DisaggDecodeEngine(AsyncEngine):
             self.local_fallbacks += 1
             return None
 
-    def _check_page_shapes(self, pages: list) -> None:
-        """Last line of defense: a wrong-shaped page must fall back to
-        local prefill here, not crash the engine loop at injection."""
+    def _check_page_shapes(self, pages: list, prompt_len: int) -> None:
+        """Last line of defense: a wrong-shaped or short transfer must
+        fall back to local prefill here, not leave uninitialized device
+        pages that decode silently attends over."""
         cfg = self.engine.cfg
+        need = (prompt_len + cfg.page_size - 1) // cfg.page_size
+        if len(pages) != need:
+            raise ValueError(f"got {len(pages)} KV pages, expected {need}")
         expected = (
             cfg.model.num_layers,
             cfg.page_size,

@@ -24,16 +24,33 @@ class MultiNodeConfig:
 
 
 def make_engine(name: str, **kwargs):
-    """Engine factory by name. ``jax`` is the native TPU engine; the echo
-    engines validate the serving pipeline without hardware."""
+    """Engine factory by name (reference: ``engines.rs:82`` make_engine_*).
+
+    ``jax``/``tpu`` is the native TPU engine: pass either ``cfg=`` (a
+    built ``EngineConfig``) or ``preset=`` (a model preset name, e.g.
+    ``"llama-1b"``) plus any ``EngineConfig`` field overrides. The echo
+    engines validate the serving pipeline without hardware.
+    """
     if name == "echo_core":
         return EchoEngineCore(**kwargs)
     if name == "echo_full":
         return EchoEngineFull(**kwargs)
-    if name == "jax":
-        from ..engine import TpuEngine
+    if name in ("jax", "tpu"):
+        from ..engine import EngineConfig, TPUEngine
 
-        return TpuEngine.build(**kwargs)
+        cfg = kwargs.pop("cfg", None)
+        if cfg is None:
+            from ..models import PRESETS
+
+            preset = kwargs.pop("preset", "llama-1b")
+            model = kwargs.pop("model", None) or PRESETS[preset]
+            ctor = {
+                k: kwargs.pop(k)
+                for k in list(kwargs)
+                if k in EngineConfig.__dataclass_fields__
+            }
+            cfg = EngineConfig(model=model, **ctor)
+        return TPUEngine(cfg, **kwargs)
     raise ValueError(f"unknown engine {name!r}")
 
 

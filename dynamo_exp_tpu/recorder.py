@@ -77,11 +77,33 @@ class KvRecorder:
         self._task: asyncio.Task | None = None
 
     async def start(self, event_plane, subject: str) -> None:
+        # Subscribe before returning: events published right after
+        # start() must land in the recording.
         stream = await event_plane.subscribe(subject)
 
-        async def pump(stream: AsyncIterator[dict]) -> None:
-            async for event in stream:
-                self.recorder.record(event)
+        async def pump(stream) -> None:
+            # Re-subscribe on connection loss so a coordinator blip
+            # doesn't silently end the recording. A dead generator is
+            # never re-iterated: each drain failure discards the stream
+            # and keeps retrying the subscribe itself until it succeeds.
+            while True:
+                try:
+                    async for event in stream:
+                        self.recorder.record(event)
+                    return  # subscription closed cleanly
+                except asyncio.CancelledError:
+                    return
+                except Exception as exc:
+                    logger.warning("kv recorder stream lost (%s); retrying", exc)
+                stream = None
+                while stream is None:
+                    await asyncio.sleep(1.0)
+                    try:
+                        stream = await event_plane.subscribe(subject)
+                    except asyncio.CancelledError:
+                        return
+                    except Exception:
+                        pass
 
         self._task = asyncio.ensure_future(pump(stream))
 

@@ -30,9 +30,26 @@ from .transports.base import (
     StatsHandler,
     WorkQueue,
 )
-from .transports.inproc import InProcDiscovery, InProcRequestPlane, next_instance_id
+from .transports.inproc import InProcDiscovery, InProcRequestPlane
 
 logger = logging.getLogger(__name__)
+
+# Endpoints served under one lease, for composing unique instance ids.
+# Per-lease (not process-global): a long-lived process serving many
+# endpoints across many leases must never overflow one lease's id range
+# into another's. The counter lives on the lease object so its state
+# dies with the lease (no global table to leak across lease churn).
+_ENDPOINTS_PER_LEASE = 10_000
+
+
+def _next_endpoint_seq(lease) -> int:
+    seq = getattr(lease, "_endpoint_seq", 0) + 1
+    if seq >= _ENDPOINTS_PER_LEASE:
+        raise RuntimeError(
+            f"lease {lease.lease_id} exceeded {_ENDPOINTS_PER_LEASE} endpoints"
+        )
+    lease._endpoint_seq = seq
+    return seq
 
 
 class DistributedRuntime:
@@ -200,7 +217,8 @@ class Endpoint:
         # process claim instance 1 and clobber its peers in discovery.
         info = InstanceInfo(
             address=self.address,
-            instance_id=lease.lease_id * 10_000 + next_instance_id(),
+            instance_id=lease.lease_id * _ENDPOINTS_PER_LEASE
+            + _next_endpoint_seq(lease),
             metadata=metadata or {},
         )
         served = await drt.request_plane.serve(info, handler, stats_handler)

@@ -144,6 +144,7 @@ class CoordinatorServer:
         self._queues: dict[str, _FifoQueue] = {}
         self._buckets: dict[str, dict[str, bytes]] = {}
         self._sweeper: asyncio.Task | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
 
     # ------------------------------------------------------------- lifecycle
     async def start(self) -> None:
@@ -165,6 +166,12 @@ class CoordinatorServer:
                 await self._sweeper
         if self._server:
             self._server.close()
+            # Python 3.12's wait_closed blocks until every connection
+            # handler returns — shutdown must not depend on clients
+            # hanging up first, so drop live connections ourselves.
+            for w in list(self._writers):
+                with contextlib.suppress(Exception):
+                    w.close()
             await self._server.wait_closed()
 
     async def serve_forever(self) -> None:
@@ -233,6 +240,7 @@ class CoordinatorServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         conn = _Conn(writer)
+        self._writers.add(writer)
         try:
             while True:
                 try:
@@ -241,6 +249,7 @@ class CoordinatorServer:
                     break
                 asyncio.ensure_future(self._dispatch(conn, msg))
         finally:
+            self._writers.discard(writer)
             for key in list(conn.watch_keys):
                 self._watches.pop(key, None)
             writer.close()
@@ -456,8 +465,12 @@ class CoordinatorClient:
     async def call(
         self, op: str, header: dict | None = None, payload: bytes = b""
     ) -> tuple[dict, bytes]:
-        if self._writer is None:
-            raise ConnectionError("not connected")
+        # Fail fast on a dead connection: if the reader task is gone its
+        # cleanup already ran, so a future registered now would never be
+        # resolved — even when the socket still accepts writes (peer sent
+        # FIN only) — and the caller would hang forever.
+        if not self.is_alive:
+            raise ConnectionError("coordinator connection lost")
         rid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut

@@ -219,3 +219,40 @@ def test_kv_manager_matched_parked_pages_not_double_counted():
     kv.register_full_page(a.page_ids[0], seq_hash=h, tokens=[1, 2, 3, 4])
     kv.release_sequence(a.page_ids)
     assert kv.allocate_sequence([1, 2, 3, 4] + list(range(10, 23)), max_pages=8) is None
+
+
+def test_make_engine_registry_jax():
+    """The factory's jax branch must construct a working engine
+    (round-1 regression: it referenced a nonexistent class/method)."""
+    from dynamo_exp_tpu.engines import make_engine
+
+    eng = make_engine(
+        "jax",
+        model=TINY,
+        max_decode_slots=2,
+        page_size=PS,
+        num_pages=32,
+        max_model_len=64,
+        seed=0,
+    )
+    assert isinstance(eng, TPUEngine)
+    assert eng.cfg.max_decode_slots == 2
+
+    async def roundtrip():
+        b = BackendInput(token_ids=[5, 6, 7])
+        b.stop_conditions.max_tokens = 4
+        b.stop_conditions.ignore_eos = True
+        toks, final = await collect(eng, b)
+        assert len(toks) == 4
+        assert final["finish_reason"] == "length"
+
+    try:
+        asyncio.run(roundtrip())
+    finally:
+        eng.stop()
+
+
+def test_make_engine_registry_echo():
+    from dynamo_exp_tpu.engines import EchoEngineCore, make_engine
+
+    assert isinstance(make_engine("echo_core"), EchoEngineCore)
