@@ -34,6 +34,16 @@ class EngineConfig:
     # Parallelism within this engine replica.
     tp: int = 1
     sp: int = 1
+    # Decode attention implementation: "auto" picks the ragged Pallas
+    # kernel on TPU and the length-bounded XLA gather elsewhere.
+    attention_impl: str = "auto"  # "auto" | "xla" | "pallas"
+    # Run the Pallas kernel in interpreter mode (CPU correctness tests).
+    pallas_interpret: bool = False
+    # Prefill batching/chunking: up to ``prefill_batch`` sequences share
+    # one prefill dispatch; prompts are fed ``prefill_chunk`` tokens at a
+    # time so decode interleaves between chunks of long prompts.
+    prefill_batch: int = 8
+    prefill_chunk: int = 512
     # Sampling defaults when the request leaves them unset.
     default_max_tokens: int = 256
     eos_token_ids: list[int] = field(default_factory=list)
@@ -70,3 +80,20 @@ class EngineConfig:
             if n <= b:
                 return b
         return None
+
+    def page_bucket_for(self, n_pages: int) -> int:
+        """Static page-count bucket for the XLA attention gather: next
+        power of two >= n_pages (min 4), capped at max_pages_per_seq.
+        Bounds the compile-variant count to O(log Pmax)."""
+        cap = self.max_pages_per_seq
+        b = 4
+        while b < n_pages:
+            b *= 2
+        return min(b, cap)
+
+    def rows_bucket_for(self, n: int) -> int:
+        """Prefill-batch row bucket (1/2/4/.../prefill_batch)."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, self.prefill_batch)

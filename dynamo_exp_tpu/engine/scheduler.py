@@ -4,9 +4,15 @@ This is the TPU replacement for the scheduling vLLM provided the
 reference for free (SURVEY.md §2.9). Single-writer: all mutation happens
 on the engine loop thread.
 
-Policy (v1): prefill-prioritized FCFS. When a decode slot is free and
-the page pool can hold the next waiting prompt, run one bucketed prefill
-and admit it; otherwise run one decode step over all active slots.
+Policy (v2): **batched, chunked, decode-interleaved prefill.** Waiting
+prompts are admitted to slots as soon as pages are available and then
+prefilled in bucketed chunks, several sequences per dispatch — so a
+burst of arrivals shares prefill forwards instead of serializing, and a
+long prompt is fed ``prefill_chunk`` tokens at a time so decode steps
+interleave between chunks instead of stalling behind one giant forward.
+Decode runs every loop iteration over all ACTIVE slots; sequences whose
+prompt is still being chunked sit in PREFILL state and don't join decode
+until their first token is sampled.
 """
 
 from __future__ import annotations
@@ -38,8 +44,9 @@ class RemoteKv:
 
 
 class SeqState(enum.Enum):
-    WAITING = "waiting"
-    ACTIVE = "active"
+    WAITING = "waiting"  # queued, no slot/pages yet
+    PREFILL = "prefill"  # slot + pages bound, prompt being chunked in
+    ACTIVE = "active"  # decoding (device slot live)
     FINISHED = "finished"
 
 
@@ -56,8 +63,16 @@ class Sequence:
     slot: int = -1
     page_ids: list[int] = field(default_factory=list)
     cached_len: int = 0  # prefix reused from the page pool
-    tokens: list[int] = field(default_factory=list)  # prompt + generated
+    tokens: list[int] = field(default_factory=list)  # prompt + confirmed
     generated: int = 0
+    # Chunked prefill progress: prompt tokens already *dispatched* to the
+    # device (including the reused prefix). The prompt is fully in flight
+    # once prefill_sent == len(prompt).
+    prefill_sent: int = 0
+    # Device-mirror of the next position a decode step will write for
+    # this slot. The host advances it at every dispatch that steps the
+    # slot, so page-boundary allocation never needs a device sync.
+    device_pos: int = -1
     # Chained hash state for registering full pages (router events + reuse).
     parent_hash: int | None = None
     hashed_pages: int = 0  # count of pages already registered
@@ -79,7 +94,7 @@ class Sequence:
 
     @property
     def pos(self) -> int:
-        """Next token position to be written."""
+        """Next token position to be confirmed-written."""
         return len(self.tokens)
 
     def last_token(self) -> int:
@@ -92,7 +107,7 @@ class Scheduler:
         self.kv = kv
         self.waiting: deque[Sequence] = deque()
         self.slots: list[Sequence | None] = [None] * cfg.max_decode_slots
-        self.active_count = 0
+        self.active_count = 0  # PREFILL + ACTIVE (slot holders)
 
     # --------------------------------------------------------------- intake
     def submit(self, seq: Sequence) -> None:
@@ -107,9 +122,10 @@ class Scheduler:
                 return i
         return None
 
-    def next_prefill(self) -> Sequence | None:
-        """Pop the next admissible waiting sequence and bind it to a slot +
-        pages. Returns None if nothing can be admitted right now."""
+    def admit_next(self) -> Sequence | None:
+        """Bind the next admissible waiting sequence to a slot + pages
+        and put it in PREFILL state. Returns None if nothing can be
+        admitted right now."""
         while self.waiting:
             if self.waiting[0].is_cancelled():
                 seq = self.waiting.popleft()
@@ -121,7 +137,10 @@ class Scheduler:
                 return None
             seq = self.waiting[0]
             if len(seq.prompt) > self.cfg.max_model_len or (
-                self.cfg.bucket_for(len(seq.prompt)) is None
+                self.cfg.bucket_for(
+                    min(len(seq.prompt), self.cfg.prefill_chunk)
+                )
+                is None
             ):
                 self.waiting.popleft()
                 seq.state = SeqState.FINISHED
@@ -140,8 +159,9 @@ class Scheduler:
             )
             self._register_uploads(seq, alloc.hashes)
             seq.tokens = list(seq.prompt)
+            seq.prefill_sent = seq.cached_len
             seq.slot = slot
-            seq.state = SeqState.ACTIVE
+            seq.state = SeqState.PREFILL
             self.slots[slot] = seq
             self.active_count += 1
             return seq
@@ -164,20 +184,19 @@ class Scheduler:
             parent = seq_hash
 
     # ------------------------------------------------------------- lifecycle
-    def ensure_decode_page(self, seq: Sequence, position: int) -> bool:
-        """Before writing ``position``: allocate a page on the boundary.
-        Returns False if the pool is dry (sequence stalls)."""
+    def ensure_page_for(self, seq: Sequence, position: int) -> int | None:
+        """Before a decode step writes ``position``: allocate a page on
+        the boundary. Returns the new page id (to be written into the
+        device page table), 0-or-positive; -1 if no allocation was
+        needed; None if the pool is dry (sequence stalls)."""
         ps = self.kv.page_size
         if position // ps < len(seq.page_ids):
-            seq.stalled = False
-            return True
+            return -1
         pid = self.kv.allocate_page()
         if pid is None:
-            seq.stalled = True
-            return False
+            return None
         seq.page_ids.append(pid)
-        seq.stalled = False
-        return True
+        return pid
 
     def register_full_pages(self, seq: Sequence) -> None:
         """Register every newly completed page for reuse + router events.
@@ -205,8 +224,9 @@ class Scheduler:
     def finish(self, seq: Sequence, reason: FinishReason) -> None:
         if seq.state == SeqState.FINISHED:
             return
+        was_bound = seq.state in (SeqState.PREFILL, SeqState.ACTIVE)
         seq.state = SeqState.FINISHED
-        if seq.slot >= 0:
+        if seq.slot >= 0 and was_bound:
             self.slots[seq.slot] = None
             self.active_count -= 1
             seq.slot = -1

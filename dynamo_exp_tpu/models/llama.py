@@ -163,11 +163,25 @@ def forward(
     page_table: jnp.ndarray,  # [B, Pmax] int32
     k_cache: jnp.ndarray,  # [L, P, ps, Hkv, D]
     v_cache: jnp.ndarray,
+    *,
+    attn_pages: int | None = None,
+    attn_impl: str = "xla",
+    mesh=None,
+    interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill or decode by bucket shape).
 
     Writes new K/V into the paged pools, attends, and returns
     (logits [B, T, V] float32, new_k_cache, new_v_cache).
+
+    ``attn_pages`` (static) bounds the XLA path's page gather: attention
+    reads only the first ``attn_pages`` table columns, so short contexts
+    don't pay Pmax-wide HBM traffic. K/V *writes* always use the full
+    table. ``attn_impl="pallas"`` switches decode (T==1) to the ragged
+    Pallas kernel (``ops/paged_decode.py``), which reads each sequence's
+    true context length — ``attn_pages`` is then irrelevant. With a
+    ``mesh`` whose ``tp`` axis is >1, the kernel runs under ``shard_map``
+    over the head axis (attention is embarrassingly parallel in heads).
     """
     B, T = tokens.shape
     hd = cfg.head_dim_
@@ -189,6 +203,13 @@ def forward(
     x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
     rope_pos = jnp.maximum(positions, 0)
 
+    use_pallas = attn_impl == "pallas" and T == 1
+    if use_pallas:
+        lengths = jnp.maximum(positions[:, 0] + 1, 0)
+    attn_table = (
+        page_table if attn_pages is None else page_table[:, :attn_pages]
+    )
+
     def layer(x, layer_in):
         lp, k_pool, v_pool = layer_in
 
@@ -202,7 +223,12 @@ def forward(
                 offsets,
                 valid,
             )
-            return paged_attention(q, kp, vp, page_table, positions), (kp, vp)
+            if use_pallas:
+                attn = _pallas_decode(
+                    q[:, 0], kp, vp, page_table, lengths, mesh, interpret
+                )[:, None]
+                return attn, (kp, vp)
+            return paged_attention(q, kp, vp, attn_table, positions), (kp, vp)
 
         return _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend)
 
@@ -210,6 +236,43 @@ def forward(
         layer, x, (params["layers"], k_cache, v_cache)
     )
     return _final_logits(params, cfg, x, eps), new_k, new_v
+
+
+def _pallas_decode(q, kp, vp, page_table, lengths, mesh, interpret):
+    """Dispatch the ragged decode kernel, sharded over tp when the mesh
+    has a tp axis wider than 1 (heads are embarrassingly parallel, so the
+    per-shard kernel sees its local heads and the full page pool rows for
+    them — no collectives)."""
+    from functools import partial as _partial
+
+    from ..ops.paged_decode import paged_decode_attention
+
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp <= 1:
+        return paged_decode_attention(
+            q, kp, vp, page_table, lengths, interpret=interpret
+        )
+    from jax import shard_map
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),
+            P(None, None, "tp", None),
+            P(None, None, "tp", None),
+            P(None, None),
+            P(None),
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )
+    def f(q_l, k_l, v_l, table, lens):
+        return paged_decode_attention(
+            q_l, k_l, v_l, table, lens, interpret=interpret
+        )
+
+    return f(q, kp, vp, page_table, lengths)
 
 
 def forward_ring_prefill(

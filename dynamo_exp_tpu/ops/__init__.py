@@ -1,9 +1,11 @@
 from .attention import dense_causal_attention, paged_attention, write_kv_pages
+from .paged_decode import paged_decode_attention
 from .rope import apply_rope, rope_frequencies
 from .sampling import apply_penalties, sample_tokens
 
 __all__ = [
     "paged_attention",
+    "paged_decode_attention",
     "dense_causal_attention",
     "write_kv_pages",
     "apply_rope",

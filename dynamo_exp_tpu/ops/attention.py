@@ -13,8 +13,12 @@ reference for free — see SURVEY.md §2.9):
 - Static shapes throughout: page tables are fixed width, masks handle the
   ragged reality, so XLA compiles once per (B, T, Pmax) bucket.
 
-This module is the always-correct XLA path and the CPU-mesh test oracle;
-a fused Pallas kernel for the decode gather is the planned fast path.
+This module is the always-correct XLA path and the CPU-mesh test oracle.
+The gather is bounded by the caller (``forward(attn_pages=...)`` slices
+the page table to the live context), and the QK/PV matmuls run in the
+cache dtype (bfloat16) with float32 accumulation on the MXU. The decode
+fast path is the ragged Pallas kernel in ``ops/paged_decode.py``, which
+this path cross-checks in tests.
 """
 
 from __future__ import annotations
@@ -69,17 +73,28 @@ def paged_attention(
     k = k_cache[page_table].reshape(B, S, Hkv, D)
     v = v_cache[page_table].reshape(B, S, Hkv, D)
 
+    # QK/PV matmuls run on the MXU in the cache dtype (bfloat16 in
+    # production) with float32 accumulation; softmax stays float32.
     qpk = H // Hkv
-    qg = q.reshape(B, T, Hkv, qpk, D).astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    scores = jnp.einsum("bthqd,bshd->bhqts", qg, kf) * scale  # [B,Hkv,qpk,T,S]
+    qg = q.reshape(B, T, Hkv, qpk, D).astype(k.dtype)
+    scores = (
+        jnp.einsum(
+            "bthqd,bshd->bhqts", qg, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )  # [B,Hkv,qpk,T,S] f32
 
     kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, None, None, :]
     mask = kv_pos <= q_positions[:, None, None, :, None]  # causal by position
     scores = jnp.where(mask, scores, -1e30)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqts,bshd->bthqd", probs, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bhqts,bshd->bthqd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, T, H, D).astype(q.dtype)
 
 
@@ -95,11 +110,21 @@ def dense_causal_attention(
     Hkv = k.shape[2]
     qpk = H // Hkv
     scale = sm_scale if sm_scale is not None else D ** -0.5
-    qg = q.reshape(B, T, Hkv, qpk, D).astype(jnp.float32)
-    scores = jnp.einsum("bthqd,bshd->bhqts", qg, k.astype(jnp.float32)) * scale
+    qg = q.reshape(B, T, Hkv, qpk, D).astype(k.dtype)
+    scores = (
+        jnp.einsum(
+            "bthqd,bshd->bhqts", qg, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     i = jnp.arange(T)[:, None]
     j = jnp.arange(T)[None, :]
     scores = jnp.where(j <= i, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqts,bshd->bthqd", probs, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bhqts,bshd->bthqd",
+        probs.astype(v.dtype),
+        v,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, T, H, D).astype(q.dtype)
