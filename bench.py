@@ -48,15 +48,23 @@ def main() -> None:
         max_model_len=512,
         prefill_buckets=[ISL],
         eos_token_ids=[],
+        # One host sync per 32 decode steps: throughput benches are
+        # sync-bound long before they are FLOP-bound on a tunneled chip.
+        decode_window=32,
     )
     engine = TPUEngine(cfg, seed=0)
     engine.start()
 
     rs = np.random.RandomState(0)
-    prompts = [
-        rs.randint(10, mcfg.vocab_size - 10, size=ISL).tolist()
-        for _ in range(CONCURRENCY)
-    ]
+    # Distinct warmup/timed prompt sets: identical shapes hit the same
+    # compiled variants, distinct tokens keep the prefix cache honest.
+    prompts, warmups = (
+        [
+            rs.randint(10, mcfg.vocab_size - 10, size=ISL).tolist()
+            for _ in range(CONCURRENCY)
+        ]
+        for _ in range(2)
+    )
 
     async def run_one(prompt):
         b = BackendInput(token_ids=prompt)
@@ -73,8 +81,13 @@ def main() -> None:
         return n, ttft
 
     async def sweep():
-        # Warmup: compile prefill + decode programs.
-        await run_one(prompts[0])
+        # Warmup: two full concurrent bursts. The first compiles every
+        # variant (prefill row/token buckets, decode window); the second
+        # matters because the tunnel's AOT compile path also makes the
+        # *second* execution of a fresh executable slow (program load).
+        # Steady-state throughput, not compile/load time, is the metric.
+        for _ in range(2):
+            await asyncio.gather(*[run_one(p) for p in warmups])
         t0 = time.perf_counter()
         results = await asyncio.gather(*[run_one(p) for p in prompts])
         dt = time.perf_counter() - t0

@@ -44,6 +44,13 @@ class EngineConfig:
     # time so decode interleaves between chunks of long prompts.
     prefill_batch: int = 8
     prefill_chunk: int = 512
+    # Decode steps per dispatch: one compiled window runs this many
+    # steps on-device (tokens fed back without touching the host) and
+    # the host syncs once per window. Amortises per-sync overhead —
+    # dominant when the host↔TPU link is a tunnel — at the cost of
+    # stop-condition latency (a sequence may overshoot its stop by up
+    # to window-1 discarded tokens).
+    decode_window: int = 8
     # Sampling defaults when the request leaves them unset.
     default_max_tokens: int = 256
     eos_token_ids: list[int] = field(default_factory=list)

@@ -4,10 +4,14 @@ This replaces the reference's wrapped GPU engines (vLLM/sglang/TRT-LLM —
 ``/root/reference/lib/engines/``, SURVEY.md §2.3/§2.9) with an in-process
 JAX engine:
 
-- **Two compiled programs** drive everything: a decode step over all
-  active slots (B = max_decode_slots, T = 1) and a bucketed prefill
-  (B = 1, T ∈ prefill_buckets). Static shapes, no recompiles in steady
-  state; KV pools are donated so XLA updates them in place in HBM.
+- **Two small families of compiled programs** drive everything: decode
+  *windows* (``lax.scan`` over ``decode_window`` steps with sampled
+  tokens fed back on-device, keyed by attention impl / page bucket /
+  sampler variant — one host sync per window, which is what survives a
+  high-latency host↔device link) and batched chunked prefill (keyed by
+  row bucket × token bucket × page bucket). Static shapes, no
+  recompiles in steady state; KV pools are donated so XLA updates them
+  in place in HBM.
 - **The host loop is the scheduler** (reference's "hard part #3",
   SURVEY.md §7): stop flags, admissions, page allocation, and KV event
   emission all happen between steps on the loop thread — never inside a
@@ -141,10 +145,18 @@ class TPUEngine(AsyncEngine):
         B, V = cfg.max_decode_slots, mcfg.vocab_size
         self._counts = jnp.zeros((B, V), jnp.int32)  # penalty bookkeeping
         self._rng = jax.random.PRNGKey(seed + 1)
-        self._decode_fn = self._build_decode()
-        self._prefill_fns: dict[int, Callable] = {}  # bucket T -> compiled fn
-        self._reset_row = jax.jit(
-            lambda c, i: c.at[i].set(0), donate_argnums=(0,)
+        self._attn_impl, self._attn_interpret = self._resolve_attn()
+        # Compiled-variant caches. Decode windows are keyed by
+        # (attention impl, static page bound — None on the Pallas path,
+        # which reads true lengths — and full-vs-greedy sampler);
+        # prefill by (row bucket, token bucket, page bound).
+        self._decode_fns: dict[tuple, Callable] = {}
+        self._prefill_fns: dict[tuple[int, int, int], Callable] = {}
+        # Fresh penalty row for a slot: zero it, then count the first
+        # sampled token so penalties see every generated token.
+        self._init_row = jax.jit(
+            lambda c, i, t: c.at[i].set(0).at[i, t].add(1),
+            donate_argnums=(0,),
         )
 
         self._submit_q: queue.Queue[Sequence] = queue.Queue()
@@ -154,27 +166,117 @@ class TPUEngine(AsyncEngine):
         self.steps = 0  # decode step counter (metrics)
 
     # ----------------------------------------------------------- compiled fns
-    def _build_decode(self):
-        cfg, mcfg = self.cfg, self.cfg.model
+    def _resolve_attn(self) -> tuple[str, bool]:
+        """Pick the decode attention implementation. ``auto`` resolves to
+        the ragged Pallas kernel only when the mesh actually sits on TPU
+        (or ``pallas_interpret`` forces interpreter mode for CPU tests);
+        anywhere else the length-bounded XLA gather is the correct
+        choice. Layouts Mosaic can't tile (``pallas_supported``) fall
+        back to XLA rather than fail at compile time on the first
+        decode."""
+        from ..ops.paged_decode import pallas_supported
 
-        @partial(jax.jit, donate_argnums=(1, 2, 7))
-        def decode_step(params, k, v, tokens, positions, page_table, rng, counts,
-                        temp, top_k, top_p, freq_pen, pres_pen, rep_pen):
-            logits, k, v = forward(
-                params, mcfg, tokens[:, None], positions[:, None], page_table, k, v
+        cfg = self.cfg
+        impl = cfg.attention_impl
+        interpret = cfg.pallas_interpret
+        if impl == "auto":
+            platform = self.mesh.devices.flat[0].platform
+            impl = "pallas" if (platform == "tpu" or interpret) else "xla"
+        if impl == "pallas" and not interpret:
+            tp = self.mesh.shape.get("tp", 1)
+            if not pallas_supported(
+                cfg.page_size,
+                cfg.model.num_kv_heads // tp,
+                cfg.model.head_dim_,
+                cfg.kv_dtype_jnp,
+            ):
+                log.warning(
+                    "KV layout (ps=%d, Hkv=%d/tp=%d, D=%d, %s) is not "
+                    "Mosaic-tileable; decode falls back to the XLA path",
+                    cfg.page_size,
+                    cfg.model.num_kv_heads,
+                    tp,
+                    cfg.model.head_dim_,
+                    cfg.kv_dtype,
+                )
+                impl = "xla"
+        return impl, interpret
+
+    def _decode_fn(self, attn_pages: int | None, full_sampler: bool):
+        """One compiled decode *window*: ``decode_window`` steps run
+        on-device under ``lax.scan`` with sampled tokens fed straight
+        back — the host syncs once per window instead of once per token,
+        which is what makes decode throughput survive a high-latency
+        host↔device link. ``full_sampler=False`` is the greedy fast
+        path (no penalties, no top-k/p machinery) used whenever every
+        stepped row is greedy.
+
+        Even when the Pallas kernel is available, short contexts take
+        the XLA gather: below ~1k tokens of page bucket the gather's
+        HBM traffic is trivial and the kernel's serial per-row DMA grid
+        costs more than it saves. The kernel wins where it matters —
+        long contexts, where gather traffic scales with B*bucket while
+        the kernel's scales with the true total context."""
+        impl, interpret, mesh = self._attn_impl, self._attn_interpret, self.mesh
+        if (
+            impl == "pallas"
+            and self.cfg.attention_impl == "auto"  # explicit pallas is honored
+            and attn_pages * self.cfg.page_size <= 1024
+        ):
+            impl = "xla"
+        pages = None if impl == "pallas" else attn_pages
+        key = (impl, pages, full_sampler)
+        fn = self._decode_fns.get(key)
+        if fn is not None:
+            return fn
+        mcfg = self.cfg.model
+        K = self.cfg.decode_window
+
+        @partial(jax.jit, donate_argnums=(1, 2, 8))
+        def decode_window(params, k, v, tokens, positions, max_pos, page_table,
+                          rng, counts, temp, top_k, top_p, freq_pen, pres_pen,
+                          rep_pen):
+            def step(carry, _):
+                tokens, positions, k, v, rng, counts = carry
+                logits, k, v = forward(
+                    params, mcfg, tokens[:, None], positions[:, None],
+                    page_table, k, v, attn_pages=pages, attn_impl=impl,
+                    mesh=mesh, interpret=interpret,
+                )
+                logits = logits[:, 0]  # [B, V]
+                if full_sampler:
+                    shaped = apply_penalties(
+                        logits, counts, freq_pen, pres_pen, rep_pen
+                    )
+                    rng2, sub = jax.random.split(rng)
+                    next_tok = sample_tokens(shaped, sub, temp, top_k, top_p)
+                else:
+                    rng2 = rng
+                    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                active = positions >= 0
+                counts = counts.at[
+                    jnp.arange(counts.shape[0]), next_tok
+                ].add(active.astype(jnp.int32))
+                # Feed the sampled token back; a row leaves the window
+                # (position -1, writes dropped) once it hits its page /
+                # model-length capacity.
+                tokens = jnp.where(active, next_tok, tokens)
+                positions = jnp.where(
+                    active & (positions < max_pos), positions + 1, -1
+                )
+                return (tokens, positions, k, v, rng2, counts), next_tok
+
+            (_, _, k, v, rng, counts), toks = jax.lax.scan(
+                step, (tokens, positions, k, v, rng, counts), None, length=K
             )
-            logits = logits[:, 0]  # [B, V]
-            logits = apply_penalties(logits, counts, freq_pen, pres_pen, rep_pen)
-            rng, sub = jax.random.split(rng)
-            next_tok = sample_tokens(logits, sub, temp, top_k, top_p)
-            active = (positions >= 0).astype(jnp.int32)
-            counts = counts.at[jnp.arange(counts.shape[0]), next_tok].add(active)
-            return next_tok, k, v, rng, counts
+            return toks, k, v, rng, counts  # toks: [K, B]
 
-        return decode_step
+        self._decode_fns[key] = decode_window
+        return decode_window
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
+    def _prefill_fn(self, rows: int, bucket: int, attn_pages: int):
+        key = (rows, bucket, attn_pages)
+        fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
         mcfg = self.cfg.model
@@ -182,13 +284,15 @@ class TPUEngine(AsyncEngine):
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefill_step(params, k, v, tokens, positions, page_table, rng,
                          last_idx, temp, top_k, top_p):
-            logits, k, v = forward(params, mcfg, tokens, positions, page_table, k, v)
-            last = jax.lax.dynamic_index_in_dim(logits[0], last_idx, keepdims=True)
+            logits, k, v = forward(
+                params, mcfg, tokens, positions, page_table, k, v,
+                attn_pages=attn_pages, last_positions=last_idx,
+            )
             rng, sub = jax.random.split(rng)
-            tok = sample_tokens(last, sub, temp[None], top_k[None], top_p[None])[0]
-            return tok, k, v, rng
+            toks = sample_tokens(logits[:, 0], sub, temp, top_k, top_p)
+            return toks, k, v, rng
 
-        self._prefill_fns[bucket] = prefill_step
+        self._prefill_fns[key] = prefill_step
         return prefill_step
 
     # -------------------------------------------------------------- lifecycle
@@ -316,6 +420,11 @@ class TPUEngine(AsyncEngine):
 
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
+        """One iteration = admit everything admissible, dispatch at most
+        one batched prefill chunk, then one decode step — so decode
+        interleaves between the chunks of long prompts instead of
+        stalling behind them (scheduler v2 policy, ``scheduler.py``
+        module docstring)."""
         try:
             while self._running:
                 if not self.sched.has_work() and self._submit_q.empty():
@@ -324,14 +433,34 @@ class TPUEngine(AsyncEngine):
                     continue
                 self._drain_submissions()
                 self._poll_cancellations()
-                seq = self.sched.next_prefill()
-                if seq is not None:
+                while self.sched.admit_next() is not None:
+                    pass
+                progressed = False
+                prefilling = [
+                    s
+                    for s in self.sched.slots
+                    if s is not None and s.state is SeqState.PREFILL
+                ]
+                # Partition the snapshot BEFORE injecting: injection
+                # clears remote_kv and promotes the sequence to ACTIVE,
+                # so filtering afterwards would re-prefill it.
+                batch = [s for s in prefilling if s.remote_kv is None]
+                for seq in prefilling:
                     if seq.remote_kv is not None:
                         self._run_remote_inject(seq)
-                    else:
-                        self._run_prefill(seq)
-                elif self.sched.active_count > 0:
-                    self._run_decode()
+                        progressed = True
+                if batch:
+                    self._run_prefill_chunk(batch[: self.cfg.prefill_batch])
+                    progressed = True
+                if any(
+                    s is not None and s.state is SeqState.ACTIVE
+                    for s in self.sched.slots
+                ):
+                    progressed = self._run_decode() or progressed
+                if not progressed:
+                    # Pool dry / everything stalled: yield briefly.
+                    self._wake.wait(timeout=0.001)
+                    self._wake.clear()
         except Exception:  # engine death must not hang clients
             log.exception("engine loop crashed; failing in-flight requests")
             self._running = False
@@ -376,8 +505,10 @@ class TPUEngine(AsyncEngine):
 
     def _finish_first_token(self, seq: Sequence, token: int) -> None:
         """Shared tail of the two admission paths (computed prefill or
-        remote-KV injection): record + announce the first sampled token."""
-        self._counts = self._reset_row(self._counts, seq.slot)
+        remote-KV injection): record + announce the first sampled token
+        and promote the sequence to decode."""
+        seq.state = SeqState.ACTIVE
+        self._counts = self._init_row(self._counts, seq.slot, token)
         seq.tokens.append(token)
         seq.generated = 1
         self.sched.register_full_pages(seq)
@@ -417,25 +548,53 @@ class TPUEngine(AsyncEngine):
                 jnp.asarray(hk),
                 jnp.asarray(hv),
             )
+        seq.remote_kv = None  # drop the host copy the moment it's injected
         self._finish_first_token(seq, rk.first_token)
 
-    def _run_prefill(self, seq: Sequence) -> None:
+    def _run_prefill_chunk(self, batch: list[Sequence]) -> None:
+        """One batched prefill dispatch: up to ``prefill_batch`` PREFILL
+        sequences each contribute their next ``prefill_chunk``-token
+        slice of prompt. Rows/tokens are bucketed so steady state hits a
+        small set of compiled variants; rows whose prompt completes this
+        chunk get their first token sampled (per-row sampling params) and
+        graduate to decode."""
         cfg = self.cfg
-        self._apply_uploads(seq)
-        suffix = seq.prompt[seq.cached_len :]
-        bucket = cfg.bucket_for(len(suffix))
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, : len(suffix)] = suffix
-        positions = np.full((1, bucket), -1, np.int32)
-        positions[0, : len(suffix)] = np.arange(
-            seq.cached_len, seq.cached_len + len(suffix)
-        )
-        table = np.zeros((1, cfg.max_pages_per_seq), np.int32)
-        table[0, : len(seq.page_ids)] = seq.page_ids
+        ps = cfg.page_size
+        rows = cfg.rows_bucket_for(len(batch))
+        sizes = [
+            min(len(s.prompt) - s.prefill_sent, cfg.prefill_chunk)
+            for s in batch
+        ]
+        bucket = cfg.bucket_for(max(sizes))
+        tokens = np.zeros((rows, bucket), np.int32)
+        positions = np.full((rows, bucket), -1, np.int32)
+        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
+        last_idx = np.zeros(rows, np.int32)
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int32)
+        top_p = np.ones(rows, np.float32)
+        completed: list[tuple[int, Sequence]] = []
+        for i, seq in enumerate(batch):
+            self._apply_uploads(seq)
+            n = sizes[i]
+            start = seq.prefill_sent
+            tokens[i, :n] = seq.prompt[start : start + n]
+            positions[i, :n] = np.arange(start, start + n)
+            table[i, : len(seq.page_ids)] = seq.page_ids
+            last_idx[i] = n - 1
+            seq.prefill_sent = start + n
+            if seq.prefill_sent == len(seq.prompt):
+                completed.append((i, seq))
+            so = seq.stop.sampling_options
+            temp[i] = so.temperature if so.temperature is not None else 0.0
+            top_k[i] = so.top_k or 0
+            top_p[i] = so.top_p if so.top_p is not None else 1.0
 
-        so = seq.stop.sampling_options
-        fn = self._prefill_fn(bucket)
-        tok, self.k_cache, self.v_cache, self._rng = fn(
+        attn_pages = cfg.page_bucket_for(
+            max((s.prefill_sent + ps - 1) // ps for s in batch)
+        )
+        fn = self._prefill_fn(rows, bucket, attn_pages)
+        toks, self.k_cache, self.v_cache, self._rng = fn(
             self.params,
             self.k_cache,
             self.v_cache,
@@ -443,19 +602,28 @@ class TPUEngine(AsyncEngine):
             jnp.asarray(positions),
             jnp.asarray(table),
             self._rng,
-            len(suffix) - 1,
-            jnp.float32(so.temperature if so.temperature is not None else 0.0),
-            jnp.int32(so.top_k or 0),
-            jnp.float32(so.top_p if so.top_p is not None else 1.0),
+            jnp.asarray(last_idx),
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
         )
-        self._finish_first_token(seq, int(tok))
+        if completed:
+            sampled = np.asarray(toks)
+            for i, seq in completed:
+                self._finish_first_token(seq, int(sampled[i]))
 
     # ----------------------------------------------------------------- decode
-    def _run_decode(self) -> None:
+    def _run_decode(self) -> bool:
+        """One decode *window* (``decode_window`` on-device steps, one
+        host sync) over every ACTIVE slot. Returns False when nothing
+        could step (page pool dry)."""
         cfg = self.cfg
+        ps = cfg.page_size
         B = cfg.max_decode_slots
+        K = cfg.decode_window
         tokens = np.zeros(B, np.int32)
         positions = np.full(B, -1, np.int32)
+        max_pos = np.full(B, -1, np.int32)
         table = np.zeros((B, cfg.max_pages_per_seq), np.int32)
         temp = np.zeros(B, np.float32)
         top_k = np.zeros(B, np.int32)
@@ -464,16 +632,28 @@ class TPUEngine(AsyncEngine):
         pres = np.zeros(B, np.float32)
         rep = np.ones(B, np.float32)
 
-        stepped: list[Sequence] = []
+        stepped: list[tuple[Sequence, int]] = []  # (seq, valid steps)
+        max_pages = 1
+        full_sampler = False
         for i, seq in enumerate(self.sched.slots):
             if seq is None or seq.state is not SeqState.ACTIVE:
                 continue
             wpos = len(seq.tokens) - 1  # position of the token being fed
-            if not self.sched.ensure_decode_page(seq, wpos):
-                continue  # pool dry: this slot idles one step
+            # Provision the whole window up front (best effort: partial
+            # allocation still lets the row run until its pages end).
+            self.sched.ensure_pages_until(seq, wpos + K - 1)
+            cap = min(cfg.max_model_len, len(seq.page_ids) * ps) - 1
+            if cap < wpos:
+                seq.stalled = True
+                continue  # pool dry: this slot idles one window
+            seq.stalled = len(seq.page_ids) * ps < min(
+                wpos + K, cfg.max_model_len
+            )
             tokens[i] = seq.last_token()
             positions[i] = wpos
+            max_pos[i] = cap
             table[i, : len(seq.page_ids)] = seq.page_ids
+            max_pages = max(max_pages, (min(wpos + K, cap + 1) + ps - 1) // ps)
             so = seq.stop.sampling_options
             temp[i] = so.temperature if so.temperature is not None else 0.0
             top_k[i] = so.top_k or 0
@@ -481,41 +661,48 @@ class TPUEngine(AsyncEngine):
             freq[i] = so.frequency_penalty or 0.0
             pres[i] = so.presence_penalty or 0.0
             rep[i] = so.repetition_penalty or 1.0
-            stepped.append(seq)
+            if temp[i] > 0.0 or freq[i] or pres[i] or rep[i] != 1.0:
+                full_sampler = True
+            stepped.append((seq, min(K, cap - wpos + 1)))
         if not stepped:
-            # Everything stalled on the page pool; yield briefly.
-            self._wake.wait(timeout=0.001)
-            return
+            return False
 
-        next_tok, self.k_cache, self.v_cache, self._rng, self._counts = (
-            self._decode_fn(
-                self.params,
-                self.k_cache,
-                self.v_cache,
-                jnp.asarray(tokens),
-                jnp.asarray(positions),
-                jnp.asarray(table),
-                self._rng,
-                self._counts,
-                jnp.asarray(temp),
-                jnp.asarray(top_k),
-                jnp.asarray(top_p),
-                jnp.asarray(freq),
-                jnp.asarray(pres),
-                jnp.asarray(rep),
-            )
+        fn = self._decode_fn(cfg.page_bucket_for(max_pages), full_sampler)
+        toks, self.k_cache, self.v_cache, self._rng, self._counts = fn(
+            self.params,
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(max_pos),
+            jnp.asarray(table),
+            self._rng,
+            self._counts,
+            jnp.asarray(temp),
+            jnp.asarray(top_k),
+            jnp.asarray(top_p),
+            jnp.asarray(freq),
+            jnp.asarray(pres),
+            jnp.asarray(rep),
         )
-        self.steps += 1
-        sampled = np.asarray(next_tok)
-        for seq in stepped:
-            token = int(sampled[seq.slot])
-            seq.tokens.append(token)
-            seq.generated += 1
+        self.steps += K
+        sampled = np.asarray(toks)  # [K, B] — the window's single sync
+        for seq, n_valid in stepped:
+            kept: list[int] = []
+            reason = None
+            for token in sampled[:n_valid, seq.slot]:
+                token = int(token)
+                kept.append(token)
+                seq.tokens.append(token)
+                seq.generated += 1
+                reason = self.sched.check_stop(seq, token)
+                if reason is not None:
+                    break
             self.sched.register_full_pages(seq)
-            reason = self.sched.check_stop(seq, token)
-            seq.emit([token], None)
+            seq.emit(kept, None)
             if reason is not None:
                 self.sched.finish(seq, reason)
+        return True
 
     # --------------------------------------------------------------- metrics
     def metrics(self) -> dict:

@@ -69,10 +69,6 @@ class Sequence:
     # device (including the reused prefix). The prompt is fully in flight
     # once prefill_sent == len(prompt).
     prefill_sent: int = 0
-    # Device-mirror of the next position a decode step will write for
-    # this slot. The host advances it at every dispatch that steps the
-    # slot, so page-boundary allocation never needs a device sync.
-    device_pos: int = -1
     # Chained hash state for registering full pages (router events + reuse).
     parent_hash: int | None = None
     hashed_pages: int = 0  # count of pages already registered
@@ -184,19 +180,20 @@ class Scheduler:
             parent = seq_hash
 
     # ------------------------------------------------------------- lifecycle
-    def ensure_page_for(self, seq: Sequence, position: int) -> int | None:
-        """Before a decode step writes ``position``: allocate a page on
-        the boundary. Returns the new page id (to be written into the
-        device page table), 0-or-positive; -1 if no allocation was
-        needed; None if the pool is dry (sequence stalls)."""
+    def ensure_pages_until(self, seq: Sequence, position: int) -> bool:
+        """Before a decode window writes up to ``position`` (inclusive):
+        allocate every page the window will cross into. Returns False if
+        the pool runs dry (the sequence sits this window out); pages
+        allocated before the dry pop stay bound to the sequence, so the
+        next attempt only needs the remainder."""
         ps = self.kv.page_size
-        if position // ps < len(seq.page_ids):
-            return -1
-        pid = self.kv.allocate_page()
-        if pid is None:
-            return None
-        seq.page_ids.append(pid)
-        return pid
+        need = min(position, self.cfg.max_model_len - 1) // ps + 1
+        while len(seq.page_ids) < need:
+            pid = self.kv.allocate_page()
+            if pid is None:
+                return False
+            seq.page_ids.append(pid)
+        return True
 
     def register_full_pages(self, seq: Sequence) -> None:
         """Register every newly completed page for reuse + router events.
@@ -256,6 +253,9 @@ class Scheduler:
         return {
             "request_active_slots": self.active_count,
             "request_total_slots": self.cfg.max_decode_slots,
+            "request_stalled_slots": sum(
+                1 for s in self.slots if s is not None and s.stalled
+            ),
             "kv_active_blocks": self.kv.active_pages,
             "kv_total_blocks": self.kv.num_pages,
             "num_requests_waiting": len(self.waiting),

@@ -168,11 +168,17 @@ def forward(
     attn_impl: str = "xla",
     mesh=None,
     interpret: bool = False,
+    last_positions: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill or decode by bucket shape).
 
     Writes new K/V into the paged pools, attends, and returns
     (logits [B, T, V] float32, new_k_cache, new_v_cache).
+
+    ``last_positions`` ([B] int32, in-chunk index of each row's last real
+    token) gathers one hidden state per row before the vocab projection,
+    so chunked prefill pays lm_head FLOPs for B positions instead of
+    B*T — the returned logits are then [B, 1, V].
 
     ``attn_pages`` (static) bounds the XLA path's page gather: attention
     reads only the first ``attn_pages`` table columns, so short contexts
@@ -235,6 +241,8 @@ def forward(
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], k_cache, v_cache)
     )
+    if last_positions is not None:
+        x = jnp.take_along_axis(x, last_positions[:, None, None], axis=1)
     return _final_logits(params, cfg, x, eps), new_k, new_v
 
 

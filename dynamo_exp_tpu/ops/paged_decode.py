@@ -37,18 +37,33 @@ from jax.experimental.pallas import tpu as pltpu
 _CHUNK_TOKENS = 128
 
 
+def pallas_supported(
+    page_size: int, num_kv_heads: int, head_dim: int, kv_dtype
+) -> bool:
+    """Whether this KV layout compiles on real TPU hardware.
+
+    Mosaic tiles the last two dims of every VMEM buffer ((8, 128) for
+    f32, (16, 128) for bf16) and rejects DMA slices that aren't
+    tile-aligned, so the collapsed lane dim (Hkv*D) must be a multiple
+    of 128 and the page size a multiple of the sublane tile. Callers
+    fall back to the XLA path otherwise (interpret mode has no such
+    constraint)."""
+    sublane = 16 if jnp.dtype(kv_dtype).itemsize == 2 else 8
+    return (num_kv_heads * head_dim) % 128 == 0 and page_size % sublane == 0
+
+
 def _decode_kernel(
     # scalar prefetch (SMEM)
     table_ref,  # [B, Pmax] int32 — page ids per sequence
     lengths_ref,  # [B] int32 — context length (0 = inactive slot)
     # inputs
     q_ref,  # [1, H, D] VMEM — this row's queries
-    k_hbm,  # [P, ps, Hkv, D] — page pool, stays in HBM
+    k_hbm,  # [P, ps, Hkv*D] — page pool, stays in HBM
     v_hbm,
     # output
     o_ref,  # [1, H, D] VMEM
     # scratch
-    k_buf,  # [2, cp*ps, Hkv, D] VMEM double buffer
+    k_buf,  # [2, cp, ps, Hkv*D] VMEM double buffer
     v_buf,
     acc_ref,  # [H, D] f32 — output accumulator
     m_ref,  # [H, 128] f32 — running max (lane-replicated)
@@ -58,6 +73,7 @@ def _decode_kernel(
     ps: int,
     cp: int,
     hkv: int,
+    hd: int,
     qpk: int,
     pmax: int,
     scale: float,
@@ -72,6 +88,10 @@ def _decode_kernel(
         Page indices beyond the sequence's table are clamped to a valid
         table entry: the DMA still runs (keeping semaphore accounting
         static) and the tokens are masked out of the softmax below.
+        Kv heads and head_dim are pre-collapsed into one lane dimension
+        (``Hkv*D``), so every copy slices only leading (untiled) dims —
+        Mosaic rejects slices of a lane dim narrower than the 128-lane
+        tile, which a [P, ps, Hkv, D] layout hits whenever D < 128.
         """
         dmas = []
         base = c * cp
@@ -81,14 +101,14 @@ def _decode_kernel(
             dmas.append(
                 pltpu.make_async_copy(
                     k_hbm.at[pid],
-                    k_buf.at[slot, pl.ds(j * ps, ps)],
+                    k_buf.at[slot, j],
                     sems.at[slot, 2 * j],
                 )
             )
             dmas.append(
                 pltpu.make_async_copy(
                     v_hbm.at[pid],
-                    v_buf.at[slot, pl.ds(j * ps, ps)],
+                    v_buf.at[slot, j],
                     sems.at[slot, 2 * j + 1],
                 )
             )
@@ -121,12 +141,13 @@ def _decode_kernel(
         tok_idx = c * S + jax.lax.broadcasted_iota(jnp.int32, (1, S), 1)
         in_ctx = tok_idx < length  # [1, S]
 
-        k = k_buf[slot]  # [S, Hkv, D]
-        v = v_buf[slot]
+        k = k_buf[slot].reshape(S, hkv * hd)  # [S, Hkv*D]
+        v = v_buf[slot].reshape(S, hkv * hd)
         for h in range(hkv):
             rows = slice(h * qpk, (h + 1) * qpk)
+            cols = slice(h * hd, (h + 1) * hd)
             qh = q[rows, :]  # [qpk, D] f32
-            kh = k[:, h, :].astype(jnp.float32)  # [S, D]
+            kh = k[:, cols].astype(jnp.float32)  # [S, D]
             s = (
                 jax.lax.dot_general(
                     qh,
@@ -147,7 +168,7 @@ def _decode_kernel(
             )
             pv = jax.lax.dot_general(
                 p.astype(v.dtype),
-                v[:, h, :],
+                v[:, cols],
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )  # [qpk, D]
@@ -181,11 +202,17 @@ def paged_decode_attention(
     (write-then-gather), so ``lengths = position + 1``.
     """
     B, H, D = q.shape
-    _, ps, Hkv, _ = k_cache.shape
+    P, ps, Hkv, _ = k_cache.shape
     pmax = page_table.shape[1]
     qpk = H // Hkv
     scale = sm_scale if sm_scale is not None else D**-0.5
     cp = max(1, min(_CHUNK_TOKENS // ps, pmax))
+
+    # Collapse (Hkv, D) into one lane dimension: page DMAs then slice
+    # only leading dims, which Mosaic accepts for any Hkv*D that is a
+    # multiple of the 128-lane tile (see pallas_supported).
+    kc = k_cache.reshape(P, ps, Hkv * D)
+    vc = v_cache.reshape(P, ps, Hkv * D)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -194,15 +221,15 @@ def paged_decode_attention(
             pl.BlockSpec(
                 (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
             ),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
             (1, H, D), lambda b, *_: (b, 0, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, cp * ps, Hkv, D), k_cache.dtype),
-            pltpu.VMEM((2, cp * ps, Hkv, D), v_cache.dtype),
+            pltpu.VMEM((2, cp, ps, Hkv * D), k_cache.dtype),
+            pltpu.VMEM((2, cp, ps, Hkv * D), v_cache.dtype),
             pltpu.VMEM((H, D), jnp.float32),
             pltpu.VMEM((H, 128), jnp.float32),
             pltpu.VMEM((H, 128), jnp.float32),
@@ -214,6 +241,7 @@ def paged_decode_attention(
         ps=ps,
         cp=cp,
         hkv=Hkv,
+        hd=D,
         qpk=qpk,
         pmax=pmax,
         scale=scale,
@@ -223,4 +251,4 @@ def paged_decode_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
-    )(page_table, lengths, q, k_cache, v_cache)
+    )(page_table, lengths, q, kc, vc)

@@ -1,0 +1,163 @@
+"""Cross-check the ragged Pallas decode kernel against the XLA oracle.
+
+The kernel (``ops/paged_decode.py``) replaces what vLLM's PagedAttention
+CUDA kernels gave the reference for free (SURVEY.md §2.9); its value is
+correctness-critical DMA/online-softmax bookkeeping, so every behaviour
+it promises is pinned here in interpreter mode on the CPU mesh:
+ragged lengths, inactive rows, GQA grouping, non-contiguous page tables,
+and the tp>1 shard_map dispatch used by ``models/llama.forward``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.ops.attention import paged_attention
+from dynamo_exp_tpu.ops.paged_decode import paged_decode_attention
+
+
+def _setup(rng, B, H, Hkv, D, P, ps, pmax, lengths, dtype=jnp.float32):
+    """Random pool + a scrambled page table; returns (q, k, v, table)."""
+    ks = jax.random.split(jax.random.PRNGKey(rng), 3)
+    q = jax.random.normal(ks[0], (B, H, D), dtype)
+    k = jax.random.normal(ks[1], (P, ps, Hkv, D), dtype)
+    v = jax.random.normal(ks[2], (P, ps, Hkv, D), dtype)
+    # Assign each row distinct, non-contiguous pages so a kernel that
+    # ignores the table (e.g. reads pages sequentially) fails loudly.
+    perm = np.random.RandomState(rng).permutation(P)
+    table = np.zeros((B, pmax), np.int32)
+    used = 0
+    for b, ln in enumerate(lengths):
+        n = max(1, -(-ln // ps))
+        table[b, :n] = perm[used : used + n]
+        used += n
+    return q, k, v, jnp.asarray(table)
+
+
+def _oracle(q, k, v, table, lengths):
+    """ops/attention.py path with per-row position masking, zeroing
+    inactive rows the way the kernel promises to."""
+    positions = jnp.asarray(lengths, jnp.int32)[:, None] - 1  # [B, 1]
+    out = paged_attention(q[:, None], k, v, table, positions)[:, 0]
+    active = (jnp.asarray(lengths) > 0)[:, None, None]
+    return jnp.where(active, out, 0.0)
+
+
+@pytest.mark.parametrize(
+    "lengths",
+    [
+        [1, 17, 32, 5],  # ragged, page-boundary straddling
+        [0, 40, 0, 3],  # inactive rows interleaved
+        [64, 64, 64, 64],  # uniform full pages
+    ],
+)
+def test_kernel_matches_oracle_ragged(lengths):
+    B, H, Hkv, D, ps, pmax = 4, 8, 4, 64, 16, 8
+    q, k, v, table = _setup(0, B, H, Hkv, D, 64, ps, pmax, lengths)
+    lens = jnp.asarray(lengths, jnp.int32)
+    got = paged_decode_attention(q, k, v, table, lens, interpret=True)
+    want = _oracle(q, k, v, table, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_inactive_rows_exact_zero():
+    lengths = [0, 9, 0, 0]
+    q, k, v, table = _setup(1, 4, 4, 4, 32, 32, 8, 4, lengths)
+    out = np.asarray(
+        paged_decode_attention(
+            q, k, v, table, jnp.asarray(lengths, jnp.int32), interpret=True
+        )
+    )
+    assert (out[[0, 2, 3]] == 0.0).all()
+    assert np.abs(out[1]).max() > 0.0
+
+
+def test_gqa_grouping():
+    # 8 query heads over 2 kv heads: groups must read their own kv head.
+    lengths = [23, 7]
+    q, k, v, table = _setup(2, 2, 8, 2, 32, 16, 8, 4, lengths)
+    lens = jnp.asarray(lengths, jnp.int32)
+    got = paged_decode_attention(q, k, v, table, lens, interpret=True)
+    want = _oracle(q, k, v, table, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_bfloat16_cache():
+    lengths = [19, 60, 1, 33]
+    q, k, v, table = _setup(3, 4, 4, 4, 64, 32, 16, 4, lengths, jnp.bfloat16)
+    lens = jnp.asarray(lengths, jnp.int32)
+    got = paged_decode_attention(q, k, v, table, lens, interpret=True)
+    want = _oracle(q, k, v, table, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), atol=2e-2
+    )
+
+
+def test_tp_shard_map_dispatch():
+    """The tp>1 path in models/llama._pallas_decode: heads sharded over
+    the mesh, page pool kv-head-sharded, full tables replicated."""
+    from dynamo_exp_tpu.models.llama import _pallas_decode
+    from dynamo_exp_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(tp=4)
+    lengths = [11, 0, 37, 25]
+    q, k, v, table = _setup(4, 4, 8, 4, 64, 32, 16, 8, lengths)
+    lens = jnp.asarray(lengths, jnp.int32)
+    got = _pallas_decode(q, k, v, table, lens, mesh, interpret=True)
+    want = _oracle(q, k, v, table, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_engine_decodes_with_pallas_interpret(tiny_model_dir):
+    """End-to-end: an engine configured with attention_impl=pallas +
+    interpret produces the same greedy tokens as the XLA engine."""
+    import asyncio
+
+    from dynamo_exp_tpu.engine.config import EngineConfig
+    from dynamo_exp_tpu.engine.engine import TPUEngine
+    from dynamo_exp_tpu.models.config import ModelConfig
+
+    mcfg = ModelConfig(
+        num_layers=2,
+        hidden_size=64,
+        num_heads=4,
+        num_kv_heads=2,
+        intermediate_size=128,
+        vocab_size=128,
+        max_position_embeddings=256,
+        dtype="float32",
+    )
+
+    def run(attention_impl):
+        cfg = EngineConfig(
+            model=mcfg,
+            max_decode_slots=2,
+            page_size=8,
+            num_pages=64,
+            max_model_len=128,
+            attention_impl=attention_impl,
+            pallas_interpret=attention_impl == "pallas",
+            enable_kv_events=False,
+        )
+        eng = TPUEngine(cfg, seed=7)
+
+        async def go():
+            stream = await eng.generate(
+                {
+                    "token_ids": list(range(1, 20)),
+                    "stop_conditions": {"max_tokens": 8},
+                    "sampling_options": {"temperature": 0.0},
+                }
+            )
+            toks = []
+            async for out in stream:
+                toks.extend(out.get("token_ids") or [])
+            return toks
+
+        try:
+            return asyncio.run(asyncio.wait_for(go(), timeout=120))
+        finally:
+            eng.stop()
+
+    assert run("pallas") == run("xla")
