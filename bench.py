@@ -1,12 +1,13 @@
 """Benchmark: engine decode throughput on the real TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default mode prints ONE JSON line: {"metric", "value", "unit",
+"vs_baseline"} for the driver.
 
-Workload (mirrors the reference harness shape, scaled to one chip —
-``/root/reference/examples/llm/benchmarks/perf.sh``: fixed ISL/OSL,
-concurrency saturating the engine, streaming): N concurrent requests,
-ISL 128 random tokens, OSL 64, through the full engine path (continuous
-batching, paged KV, sampling).
+``--sweep`` runs the reference harness shape scaled to one chip —
+ISL 3000 / OSL 150 fixed lengths, ignore_eos, concurrency sweep
+(``/root/reference/examples/llm/benchmarks/perf.sh:22-44`` uses 1→256
+on 8×H100; one v5e chip sweeps 1→32) — and prints one JSON line per
+concurrency point.
 
 ``vs_baseline`` is measured tok/s divided by the single-chip HBM
 roofline for this model (weights are re-read every decode step, so
@@ -18,12 +19,12 @@ comparison the reference never published absolute numbers for
 
 from __future__ import annotations
 
+import argparse
 import asyncio
 import json
 import time
 
 import numpy as np
-
 
 MODEL = "llama-1b"
 ISL = 128
@@ -31,10 +32,23 @@ OSL = 64
 CONCURRENCY = 32
 HBM_GBPS = 819.0  # TPU v5e
 
+SWEEP_ISL = 3000
+SWEEP_OSL = 150
+SWEEP_CONCURRENCY = (1, 4, 16, 32)
 
-def main() -> None:
+
+def _roofline_tok_s(params, batch: int) -> float:
     import jax
 
+    weight_bytes = sum(
+        int(np.prod(x.shape)) * x.dtype.itemsize
+        for x in jax.tree.leaves(params)
+    )
+    return HBM_GBPS * 1e9 / weight_bytes * batch
+
+
+def run_point(isl: int, osl: int, concurrency: int) -> dict:
+    """One measured point: build an engine, double-warm, time a burst."""
     from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
     from dynamo_exp_tpu.models import PRESETS
     from dynamo_exp_tpu.protocols.common import BackendInput
@@ -42,11 +56,10 @@ def main() -> None:
     mcfg = PRESETS[MODEL]
     cfg = EngineConfig(
         model=mcfg,
-        max_decode_slots=CONCURRENCY,
+        max_decode_slots=concurrency,
         page_size=16,
-        num_pages=CONCURRENCY * ((ISL + OSL) // 16 + 2) + 64,
-        max_model_len=512,
-        prefill_buckets=[ISL],
+        num_pages=concurrency * ((isl + osl) // 16 + 2) + 64,
+        max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
         eos_token_ids=[],
         # One host sync per 32 decode steps: throughput benches are
         # sync-bound long before they are FLOP-bound on a tunneled chip.
@@ -60,15 +73,15 @@ def main() -> None:
     # compiled variants, distinct tokens keep the prefix cache honest.
     prompts, warmups = (
         [
-            rs.randint(10, mcfg.vocab_size - 10, size=ISL).tolist()
-            for _ in range(CONCURRENCY)
+            rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
+            for _ in range(concurrency)
         ]
         for _ in range(2)
     )
 
     async def run_one(prompt):
         b = BackendInput(token_ids=prompt)
-        b.stop_conditions.max_tokens = OSL
+        b.stop_conditions.max_tokens = osl
         b.stop_conditions.ignore_eos = True
         stream = await engine.generate(b.to_dict())
         n = 0
@@ -80,7 +93,7 @@ def main() -> None:
             n += len(item.get("token_ids", []))
         return n, ttft
 
-    async def sweep():
+    async def burst():
         # Warmup: two full concurrent bursts. The first compiles every
         # variant (prefill row/token buckets, decode window); the second
         # matters because the tunnel's AOT compile path also makes the
@@ -95,25 +108,31 @@ def main() -> None:
         ttfts = sorted(t for _, t in results if t is not None)
         return total / dt, ttfts[len(ttfts) // 2]
 
-    tok_s, p50_ttft = asyncio.run(sweep())
+    tok_s, p50_ttft = asyncio.run(burst())
+    roofline = _roofline_tok_s(engine.params, concurrency)
     engine.stop()
+    return {
+        "metric": f"decode_throughput_{MODEL}_isl{isl}_osl{osl}_c{concurrency}",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / roofline, 4),
+        "p50_ttft_s": round(p50_ttft, 3),
+    }
 
-    weight_bytes = sum(
-        int(np.prod(x.shape)) * x.dtype.itemsize
-        for x in jax.tree.leaves(engine.params)
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--sweep",
+        action="store_true",
+        help="reference-shape sweep (ISL 3000 / OSL 150, concurrency 1..32)",
     )
-    roofline = HBM_GBPS * 1e9 / weight_bytes * CONCURRENCY
-    print(
-        json.dumps(
-            {
-                "metric": f"decode_throughput_{MODEL}_isl{ISL}_osl{OSL}_c{CONCURRENCY}",
-                "value": round(tok_s, 1),
-                "unit": "tok/s",
-                "vs_baseline": round(tok_s / roofline, 4),
-                "p50_ttft_s": round(p50_ttft, 3),
-            }
-        )
-    )
+    args = ap.parse_args()
+    if args.sweep:
+        for c in SWEEP_CONCURRENCY:
+            print(json.dumps(run_point(SWEEP_ISL, SWEEP_OSL, c)), flush=True)
+    else:
+        print(json.dumps(run_point(ISL, OSL, CONCURRENCY)))
 
 
 if __name__ == "__main__":

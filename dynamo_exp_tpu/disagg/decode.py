@@ -111,8 +111,7 @@ class DisaggDecodeEngine(AsyncEngine):
         expected = (
             cfg.model.num_layers,
             cfg.page_size,
-            cfg.model.num_kv_heads,
-            cfg.model.head_dim_,
+            cfg.model.num_kv_heads * cfg.model.head_dim_,
         )
         for k, v in pages:
             if tuple(k.shape) != expected or tuple(v.shape) != expected:

@@ -29,21 +29,47 @@ class PrefillWorker:
         engine: TPUEngine,
         queue: WorkQueue,
         cancel: CancellationToken | None = None,
+        component=None,
     ):
         self.engine = engine
         self.queue = queue
         self.cancel = cancel or CancellationToken()
+        self.component = component
         self.served = 0  # requests completed (metrics)
         self.failed = 0
+        self._presence = None
+
+    async def register(self) -> None:
+        """Advertise this worker on the discovery plane so the planner
+        can count the prefill fleet (reference parity: PrefillWorker's
+        discovery-only 'mock' endpoint, planner.py:88-96). Pull workers
+        take no pushed requests — the endpoint exists for presence and
+        stats only."""
+        if self.component is None:
+            return
+
+        async def handler(request: dict, context=None):
+            yield {"data": {"served": self.served, "failed": self.failed}}
+
+        self._presence = await self.component.endpoint("pull").serve_endpoint(
+            handler, stats_handler=lambda: self.engine.metrics()
+        )
 
     async def run(self) -> None:
         """Pull until cancelled. Short pull timeouts keep the drain
         window tight without busy-waiting."""
-        while not self.cancel.is_cancelled():
-            item = await self.queue.pull(timeout_s=0.25)
-            if item is None:
-                continue
-            await self._serve_one(item)
+        if self.component is not None and self._presence is None:
+            await self.register()
+        try:
+            while not self.cancel.is_cancelled():
+                item = await self.queue.pull(timeout_s=0.25)
+                if item is None:
+                    continue
+                await self._serve_one(item)
+        finally:
+            if self._presence is not None:
+                await self._presence.close()
+                self._presence = None
 
     async def _serve_one(self, item: bytes) -> None:
         try:

@@ -106,8 +106,7 @@ class TPUEngine(AsyncEngine):
             page_shape = (
                 mcfg.num_layers,
                 cfg.page_size,
-                mcfg.num_kv_heads,
-                mcfg.head_dim_,
+                mcfg.num_kv_heads * mcfg.head_dim_,
             )
             self.host_pool = HostKvPool(
                 cfg.host_cache_pages, page_shape, cfg.kv_dtype_jnp

@@ -105,17 +105,30 @@ def param_shardings(cfg: ModelConfig, tp_axis: str = "tp") -> Params:
 
 
 def kv_cache_shardings(tp_axis: str = "tp") -> tuple[P, P]:
-    """KV page pools are sharded over kv heads: [L, P, ps, Hkv, D]."""
-    spec = P(None, None, None, tp_axis, None)
+    """KV page pools are sharded over kv heads: [L, P, ps, Hkv*D] with
+    heads collapsed into the lane dim (consecutive D-blocks per head, so
+    sharding the fused axis over tp splits on head boundaries whenever
+    tp divides Hkv)."""
+    spec = P(None, None, None, tp_axis)
     return spec, spec
 
 
 def init_kv_cache(
     cfg: ModelConfig, num_pages: int, page_size: int, dtype=None
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Allocate the paged KV pools: each [L, num_pages, ps, Hkv, D]."""
+    """Allocate the paged KV pools: each [L, num_pages, ps, Hkv*D].
+
+    (kv head, head_dim) live collapsed in the trailing dim: TPU tiling
+    pads the last dim to 128 lanes, so a bare D=64 axis would double
+    every pool's HBM footprint; Hkv*D is 128-aligned for real configs.
+    """
     dt = dtype or _dtype(cfg)
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
+    shape = (
+        cfg.num_layers,
+        num_pages,
+        page_size,
+        cfg.num_kv_heads * cfg.head_dim_,
+    )
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
@@ -223,15 +236,22 @@ def forward(
             kp, vp = write_kv_pages(
                 k_pool,
                 v_pool,
-                k.reshape(B * T, cfg.num_kv_heads, hd),
-                v.reshape(B * T, cfg.num_kv_heads, hd),
+                k.reshape(B * T, cfg.num_kv_heads * hd),
+                v.reshape(B * T, cfg.num_kv_heads * hd),
                 page_ids,
                 offsets,
                 valid,
             )
             if use_pallas:
                 attn = _pallas_decode(
-                    q[:, 0], kp, vp, page_table, lengths, mesh, interpret
+                    q[:, 0],
+                    kp,
+                    vp,
+                    page_table,
+                    lengths,
+                    cfg.num_kv_heads,
+                    mesh,
+                    interpret,
                 )[:, None]
                 return attn, (kp, vp)
             return paged_attention(q, kp, vp, attn_table, positions), (kp, vp)
@@ -246,11 +266,12 @@ def forward(
     return _final_logits(params, cfg, x, eps), new_k, new_v
 
 
-def _pallas_decode(q, kp, vp, page_table, lengths, mesh, interpret):
+def _pallas_decode(q, kp, vp, page_table, lengths, hkv, mesh, interpret):
     """Dispatch the ragged decode kernel, sharded over tp when the mesh
     has a tp axis wider than 1 (heads are embarrassingly parallel, so the
     per-shard kernel sees its local heads and the full page pool rows for
-    them — no collectives)."""
+    them — no collectives). The pool's fused Hkv*D lane dim shards on
+    head boundaries (consecutive D-blocks per head)."""
     from functools import partial as _partial
 
     from ..ops.paged_decode import paged_decode_attention
@@ -258,7 +279,8 @@ def _pallas_decode(q, kp, vp, page_table, lengths, mesh, interpret):
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     if tp <= 1:
         return paged_decode_attention(
-            q, kp, vp, page_table, lengths, interpret=interpret
+            q, kp, vp, page_table, lengths, num_kv_heads=hkv,
+            interpret=interpret,
         )
     from jax import shard_map
 
@@ -267,8 +289,8 @@ def _pallas_decode(q, kp, vp, page_table, lengths, mesh, interpret):
         mesh=mesh,
         in_specs=(
             P(None, "tp", None),
-            P(None, None, "tp", None),
-            P(None, None, "tp", None),
+            P(None, None, "tp"),
+            P(None, None, "tp"),
             P(None, None),
             P(None),
         ),
@@ -277,7 +299,8 @@ def _pallas_decode(q, kp, vp, page_table, lengths, mesh, interpret):
     )
     def f(q_l, k_l, v_l, table, lens):
         return paged_decode_attention(
-            q_l, k_l, v_l, table, lens, interpret=interpret
+            q_l, k_l, v_l, table, lens, num_kv_heads=hkv // tp,
+            interpret=interpret,
         )
 
     return f(q, kp, vp, page_table, lengths)

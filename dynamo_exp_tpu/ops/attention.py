@@ -28,9 +28,9 @@ import jax.numpy as jnp
 
 
 def write_kv_pages(
-    k_cache: jnp.ndarray,  # [P, ps, Hkv, D]
+    k_cache: jnp.ndarray,  # [P, ps, Hkv*D] (heads collapsed into lanes)
     v_cache: jnp.ndarray,
-    k_new: jnp.ndarray,  # [N, Hkv, D] flattened new tokens
+    k_new: jnp.ndarray,  # [N, Hkv*D] flattened new tokens
     v_new: jnp.ndarray,
     page_ids: jnp.ndarray,  # [N] int32 global page id per new token
     offsets: jnp.ndarray,  # [N] int32 in-page offset per new token
@@ -38,7 +38,13 @@ def write_kv_pages(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Scatter new K/V rows into the page pool. Invalid rows are given an
     out-of-range page id, which XLA's ``mode="drop"`` scatter discards —
-    no write happens for them at all."""
+    no write happens for them at all.
+
+    The pool keeps (kv head, head_dim) collapsed into one trailing
+    dimension: TPU tiling pads the last dim to 128 lanes, so a separate
+    D=64 axis would double every pool's HBM footprint (and every
+    gather's traffic); Hkv*D is 128-aligned for the shapes we serve.
+    """
     num_pages = k_cache.shape[0]
     # Out-of-range page id for invalid rows => XLA drops the scatter row.
     safe_pages = jnp.where(valid, page_ids, num_pages)
@@ -53,7 +59,7 @@ def write_kv_pages(
 
 def paged_attention(
     q: jnp.ndarray,  # [B, T, H, D]
-    k_cache: jnp.ndarray,  # [P, ps, Hkv, D]
+    k_cache: jnp.ndarray,  # [P, ps, Hkv*D]
     v_cache: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, Pmax] int32
     q_positions: jnp.ndarray,  # [B, T] int32 global position of each query
@@ -65,11 +71,12 @@ def paged_attention(
     masked, so garbage in not-yet-written slots never leaks.
     """
     B, T, H, D = q.shape
-    P, ps, Hkv, _ = k_cache.shape
+    P, ps, _ = k_cache.shape
+    Hkv = k_cache.shape[2] // D
     S = page_table.shape[1] * ps
     scale = sm_scale if sm_scale is not None else D ** -0.5
 
-    # Gather this batch's pages: [B, Pmax, ps, Hkv, D] -> [B, S, Hkv, D]
+    # Gather this batch's pages: [B, Pmax, ps, Hkv*D] -> [B, S, Hkv, D]
     k = k_cache[page_table].reshape(B, S, Hkv, D)
     v = v_cache[page_table].reshape(B, S, Hkv, D)
 

@@ -184,14 +184,15 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "interpret")
+    jax.jit, static_argnames=("num_kv_heads", "sm_scale", "interpret")
 )
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, H, D]
-    k_cache: jnp.ndarray,  # [P, ps, Hkv, D]
+    k_cache: jnp.ndarray,  # [P, ps, Hkv*D] (heads collapsed into lanes)
     v_cache: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, Pmax] int32
     lengths: jnp.ndarray,  # [B] int32 — tokens to attend over (0 = inactive)
+    num_kv_heads: int | None = None,
     sm_scale: float | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -200,19 +201,20 @@ def paged_decode_attention(
     Returns [B, H, D] in q's dtype. Rows with ``lengths == 0`` return
     zeros. The caller guarantees the fed token's K/V are already written
     (write-then-gather), so ``lengths = position + 1``.
+
+    The pool's (kv head, head_dim) axes arrive collapsed into one lane
+    dimension ([P, ps, Hkv*D], the engine's storage layout): page DMAs
+    then slice only leading dims, which Mosaic accepts for any Hkv*D
+    that is a multiple of the 128-lane tile (see pallas_supported).
     """
     B, H, D = q.shape
-    P, ps, Hkv, _ = k_cache.shape
+    P, ps, fused = k_cache.shape
+    Hkv = num_kv_heads if num_kv_heads is not None else fused // D
     pmax = page_table.shape[1]
     qpk = H // Hkv
     scale = sm_scale if sm_scale is not None else D**-0.5
     cp = max(1, min(_CHUNK_TOKENS // ps, pmax))
-
-    # Collapse (Hkv, D) into one lane dimension: page DMAs then slice
-    # only leading dims, which Mosaic accepts for any Hkv*D that is a
-    # multiple of the 128-lane tile (see pallas_supported).
-    kc = k_cache.reshape(P, ps, Hkv * D)
-    vc = v_cache.reshape(P, ps, Hkv * D)
+    kc, vc = k_cache, v_cache
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

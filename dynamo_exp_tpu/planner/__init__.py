@@ -1,0 +1,12 @@
+"""Dynamic worker scaling (the reference's "planner" component).
+
+Reference parity: ``/root/reference/examples/llm/components/planner.py``
+(metric-pull + threshold decision loop) and
+``/root/reference/components/planner/src/dynamo/planner/local_connector.py``
+(scale actions against the local supervisor).
+"""
+
+from .connector import LocalConnector, PlannerConnector
+from .planner import Planner, PlannerConfig
+
+__all__ = ["Planner", "PlannerConfig", "PlannerConnector", "LocalConnector"]

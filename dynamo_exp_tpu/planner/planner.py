@@ -1,0 +1,348 @@
+"""The planner decision loop: pull metrics, scale the worker fleet.
+
+Reference parity: ``/root/reference/examples/llm/components/planner.py``
+(lines 51-357) — same signals (average KV-cache load on decode workers,
+prefill work-queue depth), same threshold policy, same safeguards:
+
+- scale-down checks run before scale-up (never both directions blind),
+- a freshly added decode worker gets a grace period
+  (``NEW_DECODE_WORKER_GRACE_PERIOD`` adjustment intervals) before any
+  decode scale-down, so its KV cache can populate,
+- prefill scale-up only when the queue's linear trend predicts it stays
+  above threshold for ``NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD``
+  intervals (workers take time to start; don't chase spikes),
+- a hard chip budget caps the fleet, and fleet-changed-underneath-us
+  aborts the adjustment round.
+
+Run standalone against a live graph:
+
+    python -m dynamo_exp_tpu.planner.planner \
+        --coordinator HOST:PORT --namespace dynamo \
+        --decode-component TpuWorker --prefill-component PrefillWorker
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+# Number of adjustment intervals a new decode worker is protected from
+# scale-down (reference: planner.py:42).
+NEW_DECODE_WORKER_GRACE_PERIOD = 3
+# Prefill scale-up looks this many intervals ahead along the queue's
+# observed trend (reference: planner.py:48).
+NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD = 3
+
+
+@dataclass
+class PlannerConfig:
+    namespace: str = "dynamo"
+    served_model_name: str = "model"
+    decode_component: str = "TpuWorker"
+    decode_endpoint: str = "generate"
+    prefill_component: str = "PrefillWorker"
+    metric_pulling_interval: float = 1.0
+    adjustment_interval: float = 10.0
+    # Chip budget and per-engine chip costs (reference speaks GPUs).
+    max_tpu_budget: int = 8
+    decode_engine_num_tpu: int = 1
+    prefill_engine_num_tpu: int = 1
+    min_endpoint: int = 1
+    prefill_queue_scale_up_threshold: float = 5.0
+    prefill_queue_scale_down_threshold: float = 0.2
+    decode_kv_scale_up_threshold: float = 0.9
+    decode_kv_scale_down_threshold: float = 0.5
+    # Estimated KV fraction one waiting request will claim once admitted
+    # (reference planner.py:170 uses the same constant).
+    waiting_request_kv_estimate: float = 0.02
+    no_operation: bool = False  # observe only
+
+
+class Planner:
+    def __init__(self, drt, config: PlannerConfig, connector=None):
+        from ..kv_router.metrics_aggregator import KvMetricsAggregator
+        from .connector import LocalConnector
+
+        self.drt = drt
+        self.cfg = config
+        self.connector = connector or LocalConnector(config.namespace, drt)
+        self.metrics_aggregator = KvMetricsAggregator(
+            drt.namespace(config.namespace).component(config.decode_component),
+            interval_s=config.metric_pulling_interval,
+        )
+        self.prefill_queue = drt.work_queue(
+            prefill_queue_name(config.served_model_name)
+        )
+        self._decode_client = None
+        self._prefill_client = None
+        self.decode_worker_remaining_grace_period = 0
+        # Per-interval samples.
+        self.kv_load: list[float] = []
+        self.prefill_queue_load: list[float] = []
+        self.adjustments: list[dict] = []  # decision log (tests/observability)
+        self._stop = asyncio.Event()
+
+    # ------------------------------------------------------------- discovery
+    async def get_workers_info(self) -> tuple[list[int], list[int]]:
+        """(prefill instance ids, decode instance ids). No prefill fleet
+        means aggregated mode (reference: planner.py:86-116)."""
+        cfg = self.cfg
+        if self._prefill_client is None:
+            try:
+                ep = (
+                    self.drt.namespace(cfg.namespace)
+                    .component(cfg.prefill_component)
+                    .endpoint("pull")
+                )
+                self._prefill_client = await ep.client()
+            except Exception:
+                self._prefill_client = None
+        p = (
+            self._prefill_client.instance_ids()
+            if self._prefill_client is not None
+            else []
+        )
+        if self._decode_client is None:
+            ep = (
+                self.drt.namespace(cfg.namespace)
+                .component(cfg.decode_component)
+                .endpoint(cfg.decode_endpoint)
+            )
+            self._decode_client = await ep.client()
+        return p, self._decode_client.instance_ids()
+
+    # --------------------------------------------------------------- metrics
+    async def collect_metrics(self) -> None:
+        cfg = self.cfg
+        try:
+            self.prefill_queue_load.append(float(await self.prefill_queue.size()))
+        except Exception as e:
+            logger.info("prefill queue size unavailable: %s", e)
+        endpoints = await self.metrics_aggregator.scrape_once()
+        for m in endpoints.metrics.values():
+            kv_load = m.gpu_cache_usage_perc
+            if m.request_active_slots and m.num_requests_waiting > 0:
+                # Waiting requests will claim cache once admitted; bias
+                # the signal up so the planner scales before they land.
+                kv_load += cfg.waiting_request_kv_estimate * m.num_requests_waiting
+            self.kv_load.append(kv_load)
+
+    def _reset_interval(self) -> None:
+        self.kv_load = []
+        self.prefill_queue_load = []
+
+    # ----------------------------------------------------------- adjustments
+    async def make_adjustments(
+        self, p_endpoints: list[int], d_endpoints: list[int]
+    ) -> None:
+        """Re-check the fleet, then apply the policy. Adjustments are
+        skipped when the fleet changed underneath the interval
+        (reference: planner.py:208-215)."""
+        new_p, new_d = await self.get_workers_info()
+        if len(new_p) != len(p_endpoints) or len(new_d) != len(d_endpoints):
+            logger.info("fleet changed mid-interval; skipping adjustments")
+            return
+        await self.make_adjustments_with_counts(p_endpoints, d_endpoints)
+
+    async def make_adjustments_with_counts(
+        self, p_endpoints: list[int], d_endpoints: list[int]
+    ) -> None:
+        """The threshold policy itself, given the interval's fleet view
+        (public so embedders/tests can drive it without discovery)."""
+        cfg = self.cfg
+        curr_chips = (
+            len(p_endpoints) * cfg.prefill_engine_num_tpu
+            + len(d_endpoints) * cfg.decode_engine_num_tpu
+        )
+        # An interval with no samples is NO signal, not zero load: a
+        # scrape outage (likeliest exactly when workers are saturated)
+        # must never read as idle and trigger a spurious scale-down.
+        # (Reference relies on np.mean([]) -> nan failing every
+        # comparison; we make it explicit.)
+        avg_queue = (
+            sum(self.prefill_queue_load) / len(self.prefill_queue_load)
+            if self.prefill_queue_load
+            else None
+        )
+        avg_kv = (
+            sum(self.kv_load) / len(self.kv_load) if self.kv_load else None
+        )
+
+        # -- scale down first (reference ordering, planner.py:225-252)
+        if (
+            p_endpoints
+            and avg_queue is not None
+            and avg_queue < cfg.prefill_queue_scale_down_threshold
+            and len(p_endpoints) > cfg.min_endpoint
+        ):
+            if await self.connector.remove_component(cfg.prefill_component):
+                curr_chips -= cfg.prefill_engine_num_tpu
+                self._log_action("remove", cfg.prefill_component, avg_queue)
+        if (
+            avg_kv is not None
+            and avg_kv < cfg.decode_kv_scale_down_threshold
+            and len(d_endpoints) > cfg.min_endpoint
+        ):
+            if self.decode_worker_remaining_grace_period > 0:
+                logger.info(
+                    "decode scale-down skipped (grace period %d)",
+                    self.decode_worker_remaining_grace_period,
+                )
+            elif await self.connector.remove_component(cfg.decode_component):
+                curr_chips -= cfg.decode_engine_num_tpu
+                self._log_action("remove", cfg.decode_component, avg_kv)
+
+        # -- scale up (prefill first: its queueing also inflates decode KV)
+        if (
+            p_endpoints
+            and avg_queue is not None
+            and avg_queue > cfg.prefill_queue_scale_up_threshold
+            and curr_chips + cfg.prefill_engine_num_tpu <= cfg.max_tpu_budget
+        ):
+            trend = (
+                self.prefill_queue_load[-1] - self.prefill_queue_load[0]
+                if len(self.prefill_queue_load) >= 2
+                else 0.0
+            )
+            predicted = (
+                self.prefill_queue_load[-1]
+                + trend * NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD
+            )
+            if predicted > cfg.prefill_queue_scale_up_threshold:
+                if await self.connector.add_component(cfg.prefill_component):
+                    curr_chips += cfg.prefill_engine_num_tpu
+                    self._log_action("add", cfg.prefill_component, avg_queue)
+            else:
+                logger.info(
+                    "prefill queue trend predicts drain (%.2f); not scaling",
+                    predicted,
+                )
+        if (
+            avg_kv is not None
+            and avg_kv > cfg.decode_kv_scale_up_threshold
+            and curr_chips + cfg.decode_engine_num_tpu <= cfg.max_tpu_budget
+        ):
+            if await self.connector.add_component(cfg.decode_component):
+                curr_chips += cfg.decode_engine_num_tpu
+                self.decode_worker_remaining_grace_period = (
+                    NEW_DECODE_WORKER_GRACE_PERIOD
+                )
+                self._log_action("add", cfg.decode_component, avg_kv)
+
+        if self.decode_worker_remaining_grace_period > 0:
+            self.decode_worker_remaining_grace_period -= 1
+
+    def _log_action(self, op: str, component: str, signal: float) -> None:
+        entry = {"op": op, "component": component, "signal": round(signal, 4)}
+        self.adjustments.append(entry)
+        logger.info("planner action: %s", entry)
+
+    # ------------------------------------------------------------------ loop
+    async def run(self) -> None:
+        cfg = self.cfg
+        p_endpoints, d_endpoints = await self.get_workers_info()
+        self._reset_interval()
+        last_adjustment = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                await self.collect_metrics()
+                if (
+                    time.monotonic() - last_adjustment
+                    >= cfg.adjustment_interval
+                ):
+                    if not cfg.no_operation:
+                        await self.make_adjustments(p_endpoints, d_endpoints)
+                    p_endpoints, d_endpoints = await self.get_workers_info()
+                    self._reset_interval()
+                    last_adjustment = time.monotonic()
+            except Exception:
+                # A transient control-plane error (coordinator blip,
+                # scrape failure) must not kill the scaling loop; retry
+                # next interval.
+                logger.exception("planner round failed; will retry")
+            try:
+                await asyncio.wait_for(
+                    self._stop.wait(), timeout=cfg.metric_pulling_interval
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def prefill_queue_name(model_name: str) -> str:
+    """Shared naming for the remote-prefill work queue (reference keys
+    its NATS stream by served model name, planner.py:61)."""
+    return f"prefill-{model_name}"
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    from ..runtime.component import DistributedRuntime
+    from ..runtime.config import RuntimeConfig
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--coordinator", required=True)
+    defaults = PlannerConfig()
+    for f in (
+        "namespace",
+        "served_model_name",
+        "decode_component",
+        "decode_endpoint",
+        "prefill_component",
+    ):
+        p.add_argument(
+            f"--{f.replace('_', '-')}", default=getattr(defaults, f)
+        )
+    for f in (
+        "metric_pulling_interval",
+        "adjustment_interval",
+        "prefill_queue_scale_up_threshold",
+        "prefill_queue_scale_down_threshold",
+        "decode_kv_scale_up_threshold",
+        "decode_kv_scale_down_threshold",
+    ):
+        p.add_argument(
+            f"--{f.replace('_', '-')}",
+            type=float,
+            default=getattr(defaults, f),
+        )
+    for f in (
+        "max_tpu_budget",
+        "decode_engine_num_tpu",
+        "prefill_engine_num_tpu",
+        "min_endpoint",
+    ):
+        p.add_argument(
+            f"--{f.replace('_', '-')}", type=int, default=getattr(defaults, f)
+        )
+    p.add_argument("--no-operation", action="store_true")
+    args = p.parse_args()
+
+    cfg = PlannerConfig(
+        **{
+            k: v
+            for k, v in vars(args).items()
+            if k != "coordinator" and hasattr(defaults, k)
+        }
+    )
+
+    async def run():
+        drt = DistributedRuntime(
+            config=RuntimeConfig(coordinator_endpoint=args.coordinator)
+        )
+        planner = Planner(drt, cfg)
+        await planner.run()
+
+    logging.basicConfig(level="INFO")
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -18,6 +18,7 @@ from .service import (
     endpoint,
     get_spec,
     service,
+    stats_handler,
 )
 
 # The reference names this decorator dynamo_endpoint; keep both spellings.
@@ -33,4 +34,5 @@ __all__ = [
     "dynamo_context",
     "ServiceConfig",
     "get_spec",
+    "stats_handler",
 ]

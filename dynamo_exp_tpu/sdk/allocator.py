@@ -20,22 +20,37 @@ class AllocationError(RuntimeError):
 
 
 class TPUAllocator:
+    """Free-set allocator: chips released by a scaled-down worker are
+    handed to the next scale-up (the planner adds/removes workers at
+    runtime, so a bump pointer would leak the budget)."""
+
     def __init__(self, total_chips: int | None = None):
         if total_chips is None:
             total_chips = int(os.environ.get("DYN_TPU_CHIPS", "4"))
         self.total_chips = total_chips
-        self._next = 0
+        self._free = set(range(total_chips))
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
 
     def assign(self, service_name: str, chips: int) -> dict[str, str]:
         """Env vars for one worker process of ``service_name``."""
         if chips <= 0:
             # Host-side service: keep JAX off the TPU entirely.
             return {"JAX_PLATFORMS": "cpu"}
-        if self._next + chips > self.total_chips:
+        if chips > len(self._free):
             raise AllocationError(
                 f"{service_name} wants {chips} TPU chips but only "
-                f"{self.total_chips - self._next} of {self.total_chips} remain"
+                f"{len(self._free)} of {self.total_chips} remain"
             )
-        ids = ",".join(str(i) for i in range(self._next, self._next + chips))
-        self._next += chips
-        return {"TPU_VISIBLE_CHIPS": ids, "TPU_VISIBLE_DEVICES": ids}
+        ids = sorted(self._free)[:chips]
+        self._free -= set(ids)
+        joined = ",".join(str(i) for i in ids)
+        return {"TPU_VISIBLE_CHIPS": joined, "TPU_VISIBLE_DEVICES": joined}
+
+    def release(self, env: dict[str, str]) -> None:
+        """Return a worker's chips (from its assign() env) to the pool."""
+        ids = env.get("TPU_VISIBLE_CHIPS", "")
+        if ids:
+            self._free |= {int(i) for i in ids.split(",")}

@@ -78,10 +78,140 @@ class Watcher:
             await self.proc.wait()
 
 
+class Supervisor:
+    """Dynamic watcher set over a service graph.
+
+    Beyond the static arbiter the reference builds on circus, this one
+    takes live scale commands — the planner's LocalConnector drives
+    ``add_worker``/``remove_worker`` through a control endpoint on the
+    coordinator (reference parity:
+    ``components/planner/src/dynamo/planner/circusd.py`` add/remove
+    watchers via the circus control socket).
+    """
+
+    def __init__(self, target: str, graph, config, allocator, endpoint: str):
+        self.target = target
+        self.specs = {s.name: s for s in graph}
+        self.config = config
+        self.allocator = allocator
+        self.endpoint = endpoint
+        self.watchers: dict[str, list[Watcher]] = {s.name: [] for s in graph}
+        self._next_idx = {s.name: 0 for s in graph}
+        self._tasks: dict[Watcher, asyncio.Task] = {}
+        self.failed: asyncio.Future | None = None
+
+    def _build_watcher(self, spec) -> Watcher:
+        from .config import ENV_VAR
+
+        env = {
+            "DYN_RUNTIME_COORDINATOR_ENDPOINT": self.endpoint,
+            ENV_VAR: self.config.dumps(),
+            **self.allocator.assign(
+                spec.name, int(spec.resources.get("tpu", 0))
+            ),
+        }
+        argv = [
+            sys.executable,
+            "-m",
+            "dynamo_exp_tpu.sdk.serve_service",
+            self.target,
+            "--service-name",
+            spec.name,
+        ]
+        idx = self._next_idx[spec.name]
+        self._next_idx[spec.name] += 1
+        return Watcher(spec, idx, argv, env)
+
+    async def add_worker(self, service_name: str) -> bool:
+        from .allocator import AllocationError
+
+        spec = self.specs.get(service_name)
+        if spec is None:
+            return False
+        try:
+            w = self._build_watcher(spec)
+        except AllocationError as e:
+            logger.warning("add_worker(%s): %s", service_name, e)
+            return False
+        try:
+            await w.start()
+        except Exception:
+            # Spawn failure must return the chips or repeated planner
+            # retries would drain the budget permanently.
+            self.allocator.release(w.env)
+            logger.exception("add_worker(%s): spawn failed", service_name)
+            return False
+        self.watchers[service_name].append(w)
+        self._tasks[w] = asyncio.ensure_future(self._supervise(w))
+        return True
+
+    async def remove_worker(self, service_name: str) -> bool:
+        """Stop the newest worker of a service (SIGTERM → child drains,
+        deregisters, lease-revokes on exit)."""
+        ws = self.watchers.get(service_name) or []
+        if not ws:
+            return False
+        w = ws.pop()
+        task = self._tasks.pop(w, None)
+        if task is not None:
+            task.cancel()
+        await w.stop()
+        self.allocator.release(w.env)
+        return True
+
+    def counts(self) -> dict[str, int]:
+        return {name: len(ws) for name, ws in self.watchers.items()}
+
+    async def _supervise(self, w: Watcher) -> None:
+        try:
+            await w.supervise()
+        except Exception as exc:  # crash-looped: surface to serve_graph
+            if self.failed is not None and not self.failed.done():
+                self.failed.set_exception(exc)
+
+    async def start_initial(self) -> None:
+        self.failed = asyncio.get_running_loop().create_future()
+        for spec in self.specs.values():
+            for _ in range(spec.workers):
+                if not await self.add_worker(spec.name):
+                    raise RuntimeError(f"failed to start {spec.name}")
+
+    async def stop_all(self) -> None:
+        for t in self._tasks.values():
+            t.cancel()
+        self._tasks.clear()
+        await asyncio.gather(
+            *[w.stop() for ws in self.watchers.values() for w in ws],
+            return_exceptions=True,
+        )
+
+    async def serve_control(self, drt, namespace: str):
+        """Control endpoint the planner's LocalConnector talks to:
+        {"op": "add"|"remove"|"list", "service": name} → one reply frame
+        {"ok": bool, "counts": {service: n}}."""
+
+        async def handler(request: dict, context=None):
+            op = request.get("op")
+            service = request.get("service", "")
+            ok = True
+            if op == "add":
+                ok = await self.add_worker(service)
+            elif op == "remove":
+                ok = await self.remove_worker(service)
+            elif op != "list":
+                ok = False
+            yield {"data": {"ok": ok, "counts": self.counts()}}
+
+        ep = drt.namespace(namespace).component("supervisor").endpoint("control")
+        return await ep.serve_endpoint(handler)
+
+
 async def serve_graph(args) -> None:
+    from ..runtime.component import DistributedRuntime
+    from ..runtime.config import RuntimeConfig
     from ..runtime.transports.coordinator import CoordinatorServer
     from .allocator import TPUAllocator
-    from .config import ENV_VAR, ServiceConfig
+    from .config import ServiceConfig
     from .serve_service import load_target
     from .service import discover_graph
 
@@ -104,39 +234,24 @@ async def serve_graph(args) -> None:
 
     config = ServiceConfig.load(args.config)
     allocator = TPUAllocator(args.tpu_chips)
-    watchers: list[Watcher] = []
-    for spec in graph:
-        for w in range(spec.workers):
-            env = {
-                "DYN_RUNTIME_COORDINATOR_ENDPOINT": endpoint,
-                ENV_VAR: config.dumps(),
-                **allocator.assign(spec.name, int(spec.resources.get("tpu", 0))),
-            }
-            argv = [
-                sys.executable,
-                "-m",
-                "dynamo_exp_tpu.sdk.serve_service",
-                args.target,
-                "--service-name",
-                spec.name,
-            ]
-            watchers.append(Watcher(spec, w, argv, env))
-
-    for w in watchers:
-        await w.start()
-    print(f"serving {len(watchers)} workers: "
-          f"{[w.name for w in watchers]}", flush=True)
-    tasks = [asyncio.ensure_future(w.supervise()) for w in watchers]
+    sup = Supervisor(args.target, graph, config, allocator, endpoint)
+    drt = DistributedRuntime(
+        config=RuntimeConfig(coordinator_endpoint=endpoint)
+    )
+    control = None
     try:
-        done, _ = await asyncio.wait(tasks, return_when=asyncio.FIRST_EXCEPTION)
-        for t in done:
-            t.result()  # propagate give-up errors
-    finally:
-        for t in tasks:
-            t.cancel()
-        await asyncio.gather(
-            *[w.stop() for w in watchers], return_exceptions=True
+        control = await sup.serve_control(drt, graph[0].namespace)
+        await sup.start_initial()
+        print(
+            f"serving {sum(sup.counts().values())} workers: {sup.counts()}",
+            flush=True,
         )
+        await sup.failed  # runs until a watcher gives up or we're cancelled
+    finally:
+        await sup.stop_all()
+        if control is not None:
+            await control.close()
+        await drt.close()
         if coordinator is not None:
             await coordinator.close()
 

@@ -69,6 +69,9 @@ async def run_service(target: str, service_name: str | None, config_path: str | 
     for hook in spec.on_start:
         await getattr(instance, hook)()
 
+    stats = (
+        getattr(instance, spec.stats_method) if spec.stats_method else None
+    )
     served = []
     for ep_name in sorted(spec.endpoints):
         bound = getattr(instance, spec.endpoints[ep_name].__name__)
@@ -84,7 +87,9 @@ async def run_service(target: str, service_name: str | None, config_path: str | 
 
             return handler
 
-        s = await component.endpoint(ep_name).serve_endpoint(make_handler(bound))
+        s = await component.endpoint(ep_name).serve_endpoint(
+            make_handler(bound), stats_handler=stats
+        )
         dynamo_context["instance_ids"][ep_name] = s.instance_id
         served.append(s)
 

@@ -31,6 +31,9 @@ class ServiceSpec:
     enabled: bool = True  # dynamo disabled = plain local object (reference)
     endpoints: dict[str, Callable] = field(default_factory=dict)
     on_start: list[str] = field(default_factory=list)
+    # Method name marked @stats_handler: () -> dict, scraped by the
+    # stats plane (planner / metrics exporter signals).
+    stats_method: str | None = None
 
     @property
     def component_name(self) -> str:
@@ -64,6 +67,8 @@ def service(
                 spec.endpoints[ep_name] = val
             if getattr(val, "__dynamo_on_start__", False):
                 spec.on_start.append(attr)
+            if getattr(val, "__dynamo_stats__", False):
+                spec.stats_method = attr
         cls.__dynamo_spec__ = spec
         return cls
 
@@ -93,6 +98,15 @@ def async_on_start(fn):
     """Run after the runtime context exists, before endpoints serve
     (reference: ``@async_on_start``)."""
     fn.__dynamo_on_start__ = True
+    return fn
+
+
+def stats_handler(fn):
+    """Mark a ``def stats(self) -> dict`` method as the service's load
+    report, scraped by the stats plane: the planner's KV-load signal and
+    the metrics exporter both read it (reference capability: the vLLM
+    worker's ``KvMetricsPublisher``, SURVEY.md §2.5)."""
+    fn.__dynamo_stats__ = True
     return fn
 
 

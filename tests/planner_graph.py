@@ -1,0 +1,40 @@
+"""Test graph for the planner e2e: a worker whose reported KV load
+tracks its in-flight requests, so synthetic request load drives the
+planner's scale signals."""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_exp_tpu.sdk import endpoint, service, stats_handler
+
+
+@service(dynamo={"namespace": "plan"}, workers=1)
+class LoadWorker:
+    SLOTS = 4
+
+    def __init__(self):
+        self.active = 0
+
+    @endpoint("generate")
+    async def generate(self, request):
+        self.active += 1
+        try:
+            for i in range(int(request.get("steps", 40))):
+                await asyncio.sleep(0.05)
+                yield {"token": i}
+        finally:
+            self.active -= 1
+
+    @stats_handler
+    def stats(self) -> dict:
+        usage = min(self.active / self.SLOTS, 1.0)
+        return {
+            "request_active_slots": self.active,
+            "request_total_slots": self.SLOTS,
+            "kv_active_blocks": self.active * 10,
+            "kv_total_blocks": self.SLOTS * 10,
+            "num_requests_waiting": max(self.active - self.SLOTS, 0),
+            "gpu_cache_usage_perc": usage,
+            "gpu_prefix_cache_hit_rate": 0.0,
+        }

@@ -42,9 +42,10 @@ def test_paged_attention_matches_dense():
 
     want = dense_causal_attention(q, k, v)
 
-    # Put k/v into pages: each batch row owns its own pages.
+    # Put k/v into pages: each batch row owns its own pages. Pools hold
+    # (Hkv, D) collapsed into the lane dim.
     pmax = T // PS
-    kc = jnp.zeros((B * pmax + 1, PS, Hkv, D))
+    kc = jnp.zeros((B * pmax + 1, PS, Hkv * D))
     vc = jnp.zeros_like(kc)
     table = (jnp.arange(B * pmax, dtype=jnp.int32).reshape(B, pmax)) + 1
     pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
@@ -53,7 +54,7 @@ def test_paged_attention_matches_dense():
     page_ids = table[bidx, flat_pos // PS]
     kc, vc = write_kv_pages(
         kc, vc,
-        k.reshape(B * T, Hkv, D), v.reshape(B * T, Hkv, D),
+        k.reshape(B * T, Hkv * D), v.reshape(B * T, Hkv * D),
         page_ids, flat_pos % PS, jnp.ones(B * T, bool),
     )
     got = paged_attention(q, kc, vc, table, pos)

@@ -18,11 +18,12 @@ from dynamo_exp_tpu.ops.paged_decode import paged_decode_attention
 
 
 def _setup(rng, B, H, Hkv, D, P, ps, pmax, lengths, dtype=jnp.float32):
-    """Random pool + a scrambled page table; returns (q, k, v, table)."""
+    """Random pool + a scrambled page table; returns (q, k, v, table).
+    Pools use the engine's fused-lane layout [P, ps, Hkv*D]."""
     ks = jax.random.split(jax.random.PRNGKey(rng), 3)
     q = jax.random.normal(ks[0], (B, H, D), dtype)
-    k = jax.random.normal(ks[1], (P, ps, Hkv, D), dtype)
-    v = jax.random.normal(ks[2], (P, ps, Hkv, D), dtype)
+    k = jax.random.normal(ks[1], (P, ps, Hkv * D), dtype)
+    v = jax.random.normal(ks[2], (P, ps, Hkv * D), dtype)
     # Assign each row distinct, non-contiguous pages so a kernel that
     # ignores the table (e.g. reads pages sequentially) fails loudly.
     perm = np.random.RandomState(rng).permutation(P)
@@ -104,7 +105,7 @@ def test_tp_shard_map_dispatch():
     lengths = [11, 0, 37, 25]
     q, k, v, table = _setup(4, 4, 8, 4, 64, 32, 16, 8, lengths)
     lens = jnp.asarray(lengths, jnp.int32)
-    got = _pallas_decode(q, k, v, table, lens, mesh, interpret=True)
+    got = _pallas_decode(q, k, v, table, lens, 4, mesh, interpret=True)
     want = _oracle(q, k, v, table, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 

@@ -1,0 +1,266 @@
+"""Planner tests: threshold decisions (unit) and the full scale-up /
+scale-down loop against a real supervisor + coordinator (e2e).
+
+Reference capability anchors:
+``examples/llm/components/planner.py:225-305`` (decision policy),
+``components/planner/src/dynamo/planner/local_connector.py`` (scale
+actions against the serve arbiter).
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from dynamo_exp_tpu.planner import Planner, PlannerConfig, PlannerConnector
+from dynamo_exp_tpu.planner.planner import (
+    NEW_DECODE_WORKER_GRACE_PERIOD,
+    prefill_queue_name,
+)
+
+
+class FakeConnector(PlannerConnector):
+    def __init__(self, fail=False):
+        self.calls: list[tuple[str, str]] = []
+        self.fail = fail
+
+    async def add_component(self, name):
+        self.calls.append(("add", name))
+        return not self.fail
+
+    async def remove_component(self, name):
+        self.calls.append(("remove", name))
+        return not self.fail
+
+
+def make_planner(connector, **kw) -> Planner:
+    """Planner with a null runtime: unit tests inject metrics directly
+    and stub discovery, so no coordinator is needed."""
+
+    class _NullQueue:
+        async def size(self):
+            return 0
+
+    class _NullDrt:
+        def namespace(self, name):
+            return self
+
+        def component(self, name):
+            return self
+
+        def work_queue(self, name):
+            return _NullQueue()
+
+    cfg = PlannerConfig(adjustment_interval=0.1, **kw)
+    p = Planner(_NullDrt(), cfg, connector=connector)
+    return p
+
+
+# ------------------------------------------------------------------- unit
+async def test_decode_scale_up_on_high_kv_load():
+    conn = FakeConnector()
+    p = make_planner(conn)
+    p.kv_load = [0.95, 0.97]
+    await p.make_adjustments_with_counts([], [1])
+    assert ("add", p.cfg.decode_component) in conn.calls
+    assert p.decode_worker_remaining_grace_period == (
+        NEW_DECODE_WORKER_GRACE_PERIOD - 1
+    )
+
+
+async def test_decode_scale_down_blocked_by_grace_period_then_allowed():
+    conn = FakeConnector()
+    p = make_planner(conn)
+    p.decode_worker_remaining_grace_period = 2
+    p.kv_load = [0.1]
+    await p.make_adjustments_with_counts([], [1, 2])
+    assert conn.calls == []  # grace period blocks
+    p.kv_load = [0.1]
+    await p.make_adjustments_with_counts([], [1, 2])
+    p.kv_load = [0.1]
+    await p.make_adjustments_with_counts([], [1, 2])
+    assert ("remove", p.cfg.decode_component) in conn.calls
+
+
+async def test_decode_scale_down_respects_min_endpoint():
+    conn = FakeConnector()
+    p = make_planner(conn, min_endpoint=1)
+    p.kv_load = [0.0]
+    await p.make_adjustments_with_counts([], [1])
+    assert conn.calls == []
+
+
+async def test_budget_caps_scale_up():
+    conn = FakeConnector()
+    p = make_planner(conn, max_tpu_budget=2, decode_engine_num_tpu=1)
+    p.kv_load = [0.99]
+    await p.make_adjustments_with_counts([], [1, 2])  # 2 chips in use already
+    assert conn.calls == []
+
+
+async def test_prefill_scale_up_needs_persistent_trend():
+    conn = FakeConnector()
+    p = make_planner(conn)
+    # Queue deep but draining fast: trend predicts below threshold.
+    p.prefill_queue_load = [20.0, 6.0]
+    await p.make_adjustments_with_counts([1], [2])
+    assert ("add", p.cfg.prefill_component) not in conn.calls
+    # Queue deep and rising: scale up.
+    p.prefill_queue_load = [6.0, 20.0]
+    await p.make_adjustments_with_counts([1], [2])
+    assert ("add", p.cfg.prefill_component) in conn.calls
+
+
+async def test_prefill_scale_down_when_queue_idle():
+    conn = FakeConnector()
+    p = make_planner(conn)
+    p.prefill_queue_load = [0.0, 0.0]
+    p.kv_load = [0.7]
+    await p.make_adjustments_with_counts([1, 2], [3])
+    assert ("remove", p.cfg.prefill_component) in conn.calls
+
+
+def test_prefill_queue_name_stable():
+    assert prefill_queue_name("m") == "prefill-m"
+
+
+async def test_planner_counts_registered_prefill_workers():
+    """PrefillWorker.register() makes the fleet visible to the planner's
+    discovery (the 'pull' presence endpoint)."""
+    import os
+
+    from dynamo_exp_tpu.disagg import PrefillWorker
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models import TINY
+    from dynamo_exp_tpu.parallel import single_device_mesh
+    from dynamo_exp_tpu.runtime.component import DistributedRuntime
+    from dynamo_exp_tpu.runtime.config import RuntimeConfig
+    from dynamo_exp_tpu.runtime.transports.coordinator import CoordinatorServer
+
+    server = CoordinatorServer()
+    await server.start()
+    drt = DistributedRuntime(
+        config=RuntimeConfig(coordinator_endpoint=server.address)
+    )
+    eng = TPUEngine(
+        EngineConfig(model=TINY, max_decode_slots=1, num_pages=16,
+                     max_model_len=64, enable_kv_events=False),
+        mesh=single_device_mesh(),
+    )
+    worker = PrefillWorker(
+        eng,
+        drt.work_queue(prefill_queue_name("m")),
+        component=drt.namespace("plan").component("PrefillWorker"),
+    )
+    try:
+        await worker.register()
+        cfg = PlannerConfig(namespace="plan", decode_component="PrefillWorker")
+        planner = Planner(drt, cfg, connector=FakeConnector())
+        p, _d = await planner.get_workers_info()
+        assert len(p) == 1
+    finally:
+        if worker._presence is not None:
+            await worker._presence.close()
+        eng.stop()
+        await drt.close()
+        await server.close()
+
+
+# -------------------------------------------------------------------- e2e
+async def test_planner_scales_supervisor_up_and_down_under_load():
+    """Synthetic load → scale-up; idle → scale-down; a discovery client
+    (the router's membership view) follows both transitions."""
+    import os
+
+    from dynamo_exp_tpu.runtime.component import DistributedRuntime
+    from dynamo_exp_tpu.runtime.config import RuntimeConfig
+    from dynamo_exp_tpu.runtime.push_router import PushRouter
+    from dynamo_exp_tpu.runtime.transports.coordinator import CoordinatorServer
+    from dynamo_exp_tpu.sdk.allocator import TPUAllocator
+    from dynamo_exp_tpu.sdk.config import ServiceConfig
+    from dynamo_exp_tpu.sdk.serve import Supervisor
+    from dynamo_exp_tpu.sdk.service import discover_graph
+
+    from .planner_graph import LoadWorker
+
+    server = CoordinatorServer()
+    await server.start()
+    os.environ["DYN_RUNTIME_COORDINATOR_ENDPOINT"] = server.address
+    graph = discover_graph(LoadWorker)
+    sup = Supervisor(
+        "tests.planner_graph:LoadWorker",
+        graph,
+        ServiceConfig.load(None),
+        TPUAllocator(8),
+        server.address,
+    )
+    drt = DistributedRuntime(
+        config=RuntimeConfig(coordinator_endpoint=server.address)
+    )
+    control = await sup.serve_control(drt, "plan")
+    planner = None
+    tasks: list[asyncio.Task] = []
+    try:
+        await sup.start_initial()
+        ep = drt.namespace("plan").component("LoadWorker").endpoint("generate")
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=30)
+
+        cfg = PlannerConfig(
+            namespace="plan",
+            decode_component="LoadWorker",
+            metric_pulling_interval=0.2,
+            adjustment_interval=1.0,
+            decode_kv_scale_up_threshold=0.7,
+            decode_kv_scale_down_threshold=0.3,
+            max_tpu_budget=2,
+            decode_engine_num_tpu=1,
+        )
+        planner = Planner(drt, cfg)
+        tasks.append(asyncio.ensure_future(planner.run()))
+
+        # Synthetic load: saturate the single worker's 4 slots.
+        router = PushRouter(client)
+
+        async def drive():
+            stream = await router.generate({"steps": 200})
+            with contextlib.suppress(Exception):
+                async for _ in stream:
+                    pass
+
+        load = [asyncio.ensure_future(drive()) for _ in range(4)]
+
+        async def wait_for(cond, timeout):
+            deadline = asyncio.get_running_loop().time() + timeout
+            while asyncio.get_running_loop().time() < deadline:
+                if cond():
+                    return True
+                await asyncio.sleep(0.2)
+            return False
+
+        # Scale-up observed at the supervisor AND by the discovery client.
+        assert await wait_for(
+            lambda: sup.counts()["LoadWorker"] >= 2, 30
+        ), f"no scale-up: {planner.adjustments}"
+        assert await wait_for(lambda: len(client.instance_ids()) >= 2, 30)
+
+        # Idle: cancel the load, wait out the grace period, expect
+        # scale-down back to min_endpoint and the client to see it.
+        for t in load:
+            t.cancel()
+        await asyncio.gather(*load, return_exceptions=True)
+        assert await wait_for(
+            lambda: sup.counts()["LoadWorker"] == 1, 60
+        ), f"no scale-down: {planner.adjustments}"
+        assert await wait_for(lambda: len(client.instance_ids()) == 1, 30)
+    finally:
+        if planner is not None:
+            planner.stop()
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        await sup.stop_all()
+        await control.close()
+        await drt.close()
+        await server.close()
+        os.environ.pop("DYN_RUNTIME_COORDINATOR_ENDPOINT", None)

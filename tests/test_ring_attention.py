@@ -96,13 +96,14 @@ def test_ring_prefill_matches_paged_forward():
     np.testing.assert_allclose(
         np.asarray(got_logits), np.asarray(want_logits), atol=2e-3, rtol=1e-3
     )
-    # Ring K/V is [L, B, T, Hkv, D]; oracle pool is [L, P, ps, Hkv, D].
-    L, Pn, _, Hkv, D = np.asarray(want_k).shape
+    # Ring K/V is [L, B, T, Hkv, D]; oracle pool is [L, P, ps, Hkv*D]
+    # (the fused-lane layout).
+    L, Pn, _, fused = np.asarray(want_k).shape
     np.testing.assert_allclose(
-        np.asarray(got_k).reshape(L, Pn, ps, Hkv, D), np.asarray(want_k), atol=1e-5
+        np.asarray(got_k).reshape(L, Pn, ps, fused), np.asarray(want_k), atol=1e-5
     )
     np.testing.assert_allclose(
-        np.asarray(got_v).reshape(L, Pn, ps, Hkv, D), np.asarray(want_v), atol=1e-5
+        np.asarray(got_v).reshape(L, Pn, ps, fused), np.asarray(want_v), atol=1e-5
     )
 
 
