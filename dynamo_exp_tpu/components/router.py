@@ -1,0 +1,109 @@
+"""Standalone KV-aware router service.
+
+Capability parity with ``/root/reference/components/router/src/main.rs``
+(:33-60): the KV router as its own discoverable component — it watches a
+worker component's KV events + load metrics and serves
+``RouterRequest{token_ids} → RouterResponse{worker_id, overlap_blocks}``
+on a ``generate`` endpoint, so any ingress (not just the embedded
+in-process router) can ask "which worker for these tokens?".
+
+    python -m dynamo_exp_tpu.components.router \
+        --coordinator HOST:PORT --namespace dynamo \
+        --workers TpuWorker --block-size 16 \
+        [--component kv_aware_router]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class RouterService:
+    """Owns a KvRouter watching ``worker_component`` and serves it on
+    ``router_component``'s ``generate`` endpoint."""
+
+    def __init__(
+        self,
+        drt,
+        namespace: str,
+        worker_component: str,
+        block_size: int,
+        router_component: str = "kv_aware_router",
+    ):
+        from ..kv_router.router import KvRouter
+
+        self.drt = drt
+        self.router = KvRouter(
+            drt.namespace(namespace).component(worker_component),
+            block_size=block_size,
+        )
+        self.endpoint = (
+            drt.namespace(namespace)
+            .component(router_component)
+            .endpoint("generate")
+        )
+        self._served = None
+
+    async def start(self) -> int:
+        from ..runtime.component import annotated_stream
+
+        await self.router.start()
+
+        async def handler(request: dict, context=None):
+            async for frame in annotated_stream(self.router, request, context):
+                yield frame
+
+        self._served = await self.endpoint.serve_endpoint(handler)
+        logger.info(
+            "kv router serving %s (watching %s)",
+            self.endpoint.path,
+            self.router.component.path,
+        )
+        return self._served.instance_id
+
+    async def stop(self) -> None:
+        if self._served is not None:
+            await self._served.close()
+            self._served = None
+        await self.router.stop()
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+
+    from ..runtime.component import DistributedRuntime
+    from ..runtime.config import RuntimeConfig
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--workers", required=True,
+                   help="worker component whose KV events/metrics to watch")
+    p.add_argument("--block-size", type=int, required=True)
+    p.add_argument("--component", default="kv_aware_router")
+    args = p.parse_args()
+
+    async def run():
+        drt = DistributedRuntime(
+            config=RuntimeConfig(coordinator_endpoint=args.coordinator)
+        )
+        svc = RouterService(
+            drt, args.namespace, args.workers, args.block_size, args.component
+        )
+        iid = await svc.start()
+        print(f"kv router instance {iid}", flush=True)
+        with contextlib.suppress(asyncio.CancelledError):
+            await asyncio.Event().wait()
+        await svc.stop()
+        await drt.close()
+
+    logging.basicConfig(level="INFO")
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,13 +1,21 @@
 """KV-page transfer plane: direct TCP between prefill and decode workers.
 
-The reference moves KV blocks GPU→GPU with NIXL/UCX RDMA writes plus a
-completion notification (``/root/reference/container/deps/vllm/…patch:
-1040-1862``). On TPU there is no peer-to-peer RDMA library; the
-equivalent is host-bounce: the prefill engine gathers pages to host
-numpy (XLA dynamic-slice + device→host DMA), this plane ships the bytes
-over one TCP message, and the decode engine injects them (host→device
-DMA + scatter). The two-part codec keeps the payload opaque — one frame
-carries every page of a request, so the handoff costs one round trip.
+The reference moves KV blocks GPU→GPU with *incremental* NIXL/UCX RDMA
+writes plus a completion notification (``/root/reference/container/
+deps/vllm/…patch:1040-1862`` issues per-block writes as blocks finish).
+On TPU there is no peer-to-peer RDMA library; the equivalent is
+host-bounce: the prefill engine gathers pages to host numpy (XLA
+dynamic-slice + device→host DMA), this plane ships the bytes, and the
+decode engine injects them (host→device DMA + scatter).
+
+Framing mirrors the reference's incremental writes: a BEGIN frame, then
+``chunk_pages``-page DATA frames under a bounded in-flight ack window
+(sender never buffers more than ``window`` unacked frames on the wire),
+then END. An 8B model at 3k ISL is hundreds of MB of KV — one giant
+frame would hold that entire payload in RAM at both ends and deliver
+nothing until the last byte; chunking caps per-frame memory and lets
+the receiver consume (and ultimately inject) pages while later pages
+are still in flight (``KvPageReceiver.expect(on_chunk=...)``).
 
 Dtype note: pages travel as raw bytes tagged with the dtype name;
 bfloat16 numpy arrays (via ml_dtypes) round-trip through
@@ -68,28 +76,76 @@ def decode_pages(header: dict, payload: bytes) -> list[tuple[np.ndarray, np.ndar
     return pages
 
 
+# Defaults for the chunked transfer: pages per DATA frame and the
+# bounded number of unacked frames in flight.
+DEFAULT_CHUNK_PAGES = 4
+DEFAULT_WINDOW = 4
+
+
 async def send_kv_pages(
     return_addr: str,
     request_id: str,
     first_token: int,
     pages: list[tuple[np.ndarray, np.ndarray]],
     error: str | None = None,
+    chunk_pages: int = DEFAULT_CHUNK_PAGES,
+    window: int = DEFAULT_WINDOW,
 ) -> None:
-    """Deliver one prefill result (or failure notice) to a decode worker."""
+    """Deliver one prefill result (or failure notice) to a decode worker.
+
+    Pages go out as ``chunk_pages``-page DATA frames with at most
+    ``window`` frames unacknowledged — per-frame memory at both ends is
+    capped at ``chunk_pages * page_bytes`` regardless of prompt length,
+    and arrival overlaps transmission.
+    """
     host, _, port = return_addr.rpartition(":")
     reader, writer = await asyncio.open_connection(host or "127.0.0.1", int(port))
     try:
         if error is not None:
-            msg = TwoPartMessage(
-                MsgType.ERROR, {"request_id": request_id, "error": error}
+            await write_message(
+                writer,
+                TwoPartMessage(
+                    MsgType.ERROR, {"request_id": request_id, "error": error}
+                ),
             )
-        else:
-            header, payload = encode_pages(pages)
-            header.update({"request_id": request_id, "first_token": first_token})
-            msg = TwoPartMessage(MsgType.FRAME, header, payload)
-        await write_message(writer, msg)
-        # Wait for the ack so the pages are known-delivered before the
-        # prefill worker releases/reuses its device pages.
+            await read_message(reader)
+            return
+        chunks = [
+            pages[i : i + chunk_pages]
+            for i in range(0, len(pages), chunk_pages)
+        ]
+        begin = {
+            "request_id": request_id,
+            "first_token": first_token,
+            "kind": "begin",
+            "n_pages": len(pages),
+            "n_chunks": len(chunks),
+        }
+        await write_message(writer, TwoPartMessage(MsgType.FRAME, begin))
+        unacked = 0
+        for idx, chunk in enumerate(chunks):
+            header, payload = encode_pages(chunk)
+            header.update(
+                {"request_id": request_id, "kind": "data", "chunk": idx}
+            )
+            await write_message(
+                writer, TwoPartMessage(MsgType.FRAME, header, payload)
+            )
+            unacked += 1
+            if unacked >= window:
+                await read_message(reader)  # per-chunk ack
+                unacked -= 1
+        while unacked > 0:
+            await read_message(reader)
+            unacked -= 1
+        await write_message(
+            writer,
+            TwoPartMessage(
+                MsgType.FRAME, {"request_id": request_id, "kind": "end"}
+            ),
+        )
+        # Final ack: pages are known-delivered before the prefill worker
+        # releases/reuses its device pages.
         await read_message(reader)
     finally:
         writer.close()
@@ -106,6 +162,7 @@ class KvPageReceiver:
         self.port = port
         self._server: asyncio.AbstractServer | None = None
         self._pending: dict[str, asyncio.Future] = {}
+        self._chunk_cbs: dict[str, object] = {}
 
     @property
     def address(self) -> str:
@@ -124,41 +181,90 @@ class KvPageReceiver:
             if not fut.done():
                 fut.set_exception(ConnectionError("KV receiver closed"))
         self._pending.clear()
+        self._chunk_cbs.clear()
 
-    def expect(self, request_id: str) -> asyncio.Future:
+    def expect(self, request_id: str, on_chunk=None) -> asyncio.Future:
         """Register interest *before* queueing the prefill request, so the
-        result can't race past us."""
+        result can't race past us. ``on_chunk(pages)`` (if given) fires
+        per arriving DATA frame — the hook that lets a decode engine
+        start injecting while later pages are still in flight; pages
+        then travel ONLY through the callback (bounded receiver memory)
+        and the future resolves to (first_token, []) at END."""
         fut = asyncio.get_running_loop().create_future()
         self._pending[request_id] = fut
+        if on_chunk is not None:
+            self._chunk_cbs[request_id] = on_chunk
         return fut
 
     def forget(self, request_id: str) -> None:
         self._pending.pop(request_id, None)
+        self._chunk_cbs.pop(request_id, None)
 
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         fut = None
+        rid = ""
         try:
             msg = await read_message(reader)
             rid = msg.header.get("request_id", "")
             fut = self._pending.pop(rid, None)
             if fut is None or fut.done():
                 logger.warning("KV pages for unknown request %s dropped", rid)
+                # Still drain the sender's frames so it doesn't hang on
+                # acks, then ack-close.
+                if msg.header.get("kind") == "begin":
+                    while msg.header.get("kind") != "end":
+                        await write_message(
+                            writer,
+                            TwoPartMessage(MsgType.COMPLETE, {"ok": True}),
+                        )
+                        msg = await read_message(reader)
             elif msg.msg_type == MsgType.ERROR:
-                fut.set_exception(RuntimeError(msg.header.get("error", "prefill failed")))
+                fut.set_exception(
+                    RuntimeError(msg.header.get("error", "prefill failed"))
+                )
+            elif msg.header.get("kind") == "begin":
+                first_token = msg.header["first_token"]
+                on_chunk = self._chunk_cbs.pop(rid, None)
+                pages: list = []
+                while True:
+                    msg = await read_message(reader)
+                    if msg.header.get("kind") == "end":
+                        break
+                    chunk = decode_pages(msg.header, msg.payload)
+                    if on_chunk is not None:
+                        # Streaming consumer: pages leave through the
+                        # callback as they land (the receiver-side
+                        # memory bound); the future carries only the
+                        # first token so nothing is delivered twice.
+                        on_chunk(chunk)
+                    else:
+                        pages.extend(chunk)
+                    await write_message(
+                        writer, TwoPartMessage(MsgType.COMPLETE, {"ok": True})
+                    )
+                fut.set_result((first_token, pages))
             else:
+                # Single-frame form (legacy senders).
                 pages = decode_pages(msg.header, msg.payload)
                 fut.set_result((msg.header["first_token"], pages))
             await write_message(writer, TwoPartMessage(MsgType.COMPLETE, {"ok": True}))
-        except (asyncio.IncompleteReadError, ConnectionError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError) as e:
+            # A connection drop mid-transfer must fail the waiting
+            # request immediately: the future was already popped from
+            # _pending, so close() can no longer reach it.
+            if fut is not None and not fut.done():
+                fut.set_exception(
+                    ConnectionError(f"KV transfer dropped mid-stream: {e}")
+                )
         except Exception as e:  # noqa: BLE001 - a malformed frame must fail
             # the waiting request *now*, not leave it to time out.
             logger.exception("bad KV transfer frame")
             if fut is not None and not fut.done():
                 fut.set_exception(RuntimeError(f"bad KV transfer frame: {e}"))
         finally:
+            self._chunk_cbs.pop(rid, None)
             writer.close()
             with contextlib.suppress(Exception):
                 await writer.wait_closed()

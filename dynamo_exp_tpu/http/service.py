@@ -67,11 +67,15 @@ class HttpService:
         metrics: ServiceMetrics | None = None,
         host: str = "0.0.0.0",
         port: int = 8080,
+        request_template=None,
     ):
         self.manager = manager or ModelManager()
         self.metrics = metrics or ServiceMetrics()
         self.host = host
         self.port = port
+        # Server-side defaults for sparse request bodies (reference:
+        # request_template.rs applied in dynamo-run's HTTP input).
+        self.request_template = request_template
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat)
         self.app.router.add_post("/v1/completions", self._completions)
@@ -149,6 +153,8 @@ class HttpService:
     ) -> web.StreamResponse:
         try:
             payload = await request.json()
+            if self.request_template is not None:
+                payload = self.request_template.apply(payload)
             req = parse(payload)
         except Exception as e:
             return _error_response(400, f"invalid request: {e}")

@@ -129,11 +129,19 @@ async def build_routed_core(endpoint, mode: RouterMode, block_size: int):
     so the two can't drift.
     """
     client = await endpoint.client()
+    # Ingress may accept requests moments before the worker fleet's
+    # discovery snapshot lands; absorb that race instead of 503ing.
     if mode is RouterMode.KV:
         kv_router = KvRouter(endpoint.component, block_size=block_size)
         await kv_router.start()
-        return KvPushRouter(PushRouter(client, RouterMode.DIRECT), kv_router), kv_router
-    return PushRouter(client, mode), None
+        return (
+            KvPushRouter(
+                PushRouter(client, RouterMode.DIRECT, ready_wait_s=30.0),
+                kv_router,
+            ),
+            kv_router,
+        )
+    return PushRouter(client, mode, ready_wait_s=30.0), None
 
 
 __all__ = ["KvRouter", "KvPushRouter", "RouterMode", "build_routed_core"]

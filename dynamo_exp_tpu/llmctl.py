@@ -1,0 +1,158 @@
+"""llmctl: control CLI over the live model-registration plane.
+
+Capability parity with ``/root/reference/launch/llmctl/src/main.rs``
+(:101-454): add / list / remove model registrations against the running
+control plane, so operators can attach models to ingress (or detach
+them) without touching workers.
+
+    python -m dynamo_exp_tpu.llmctl --coordinator HOST:PORT \
+        http add chat-model foo/v1 dynamo.TpuWorker.generate \
+        [--model-path /models/foo]
+    python -m dynamo_exp_tpu.llmctl --coordinator HOST:PORT http list
+    python -m dynamo_exp_tpu.llmctl --coordinator HOST:PORT \
+        http remove model foo/v1
+
+Entries added here are NOT lease-scoped (no worker owns them): they
+represent operator intent and persist until removed, exactly like the
+reference's etcd writes from llmctl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from .local_model import MDC_BUCKET, MODELS_PREFIX, ModelEntry
+
+_TYPES = {"chat-model": "chat", "completion-model": "completion", "model": "both"}
+
+
+def _slug(name: str) -> str:
+    return name.replace("/", "--")
+
+
+async def add_model(drt, args) -> int:
+    entry = ModelEntry(
+        name=args.model_name,
+        endpoint=_qualify(args.endpoint_name, args.namespace),
+        model_type=_TYPES[args.model_type],
+        mdc_key=_slug(args.model_name),
+    )
+    if args.model_path:
+        from .model_card import ModelDeploymentCard
+
+        mdc = ModelDeploymentCard.from_local_path(
+            args.model_path, args.model_name
+        )
+        await drt.object_store.put(
+            MDC_BUCKET, entry.mdc_key, mdc.to_json().encode()
+        )
+    key = f"{MODELS_PREFIX}{_slug(args.model_name)}/llmctl"
+    await drt.discovery.kv_put(key, entry.to_bytes())
+    print(f"added {entry.model_type} model {entry.name} -> {entry.endpoint}")
+    return 0
+
+
+async def list_models(drt, args) -> int:
+    entries = await drt.discovery.kv_get_prefix(MODELS_PREFIX)
+    want = _TYPES.get(args.model_type or "model", "both")
+    rows = []
+    for key, raw in sorted(entries.items()):
+        try:
+            e = ModelEntry.from_bytes(raw)
+        except (ValueError, TypeError, KeyError):
+            continue
+        if want != "both" and e.model_type not in (want, "both"):
+            continue
+        rows.append((e.name, e.model_type, e.endpoint, key.rsplit("/", 1)[-1]))
+    if args.json:
+        print(json.dumps([
+            {"name": n, "type": t, "endpoint": ep, "owner": o}
+            for n, t, ep, o in rows
+        ]))
+        return 0
+    if not rows:
+        print("no models registered")
+        return 0
+    width = max(len(r[0]) for r in rows)
+    for name, mtype, ep, owner in rows:
+        print(f"{name:<{width}}  {mtype:<10}  {ep}  ({owner})")
+    return 0
+
+
+async def remove_model(drt, args) -> int:
+    prefix = f"{MODELS_PREFIX}{_slug(args.model_name)}/"
+    entries = await drt.discovery.kv_get_prefix(prefix)
+    if not entries:
+        print(f"no registration for {args.model_name}", file=sys.stderr)
+        return 1
+    for key in entries:
+        await drt.discovery.kv_delete(key)
+    print(f"removed {len(entries)} registration(s) for {args.model_name}")
+    return 0
+
+
+def _qualify(endpoint: str, namespace: str) -> str:
+    """component.endpoint or namespace.component.endpoint → dyn:// URL."""
+    if endpoint.startswith("dyn://"):
+        endpoint = endpoint[len("dyn://") :]
+    parts = endpoint.split(".")
+    if len(parts) == 2:
+        parts = [namespace, *parts]
+    if len(parts) != 3:
+        raise SystemExit(
+            f"endpoint must be [ns.]component.endpoint, got {endpoint!r}"
+        )
+    return "dyn://" + ".".join(parts)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="llmctl", description=__doc__)
+    p.add_argument("--coordinator", required=True, help="control plane host:port")
+    p.add_argument("-n", "--namespace", default="dynamo")
+    sub = p.add_subparsers(dest="plane", required=True)
+    http = sub.add_parser("http", help="HTTP-served model registrations")
+    hsub = http.add_subparsers(dest="command", required=True)
+
+    add = hsub.add_parser("add")
+    add.add_argument("model_type", choices=sorted(_TYPES))
+    add.add_argument("model_name")
+    add.add_argument("endpoint_name")
+    add.add_argument("--model-path", default="", help="publish an MDC too")
+
+    lst = hsub.add_parser("list")
+    lst.add_argument("model_type", nargs="?", choices=sorted(_TYPES))
+    lst.add_argument("--json", action="store_true")
+
+    rm = hsub.add_parser("remove")
+    rm.add_argument("model_type", choices=sorted(_TYPES))
+    rm.add_argument("model_name")
+    return p
+
+
+async def run(args) -> int:
+    from .runtime.component import DistributedRuntime
+    from .runtime.config import RuntimeConfig
+
+    drt = DistributedRuntime(
+        config=RuntimeConfig(coordinator_endpoint=args.coordinator)
+    )
+    try:
+        if args.command == "add":
+            return await add_model(drt, args)
+        if args.command == "list":
+            return await list_models(drt, args)
+        return await remove_model(drt, args)
+    finally:
+        await drt.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

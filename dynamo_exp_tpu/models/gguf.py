@@ -1,0 +1,331 @@
+"""GGUF checkpoint support: parse, map to ModelConfig, load params.
+
+Capability parity with ``/root/reference/lib/llm/src/gguf.rs`` (which
+adapts mistral.rs's reader: metadata → config, tensors → weights). This
+is a from-scratch reader of the public GGUF v2/v3 container format
+(header, typed metadata KV section, tensor index, aligned data blob) —
+no llama.cpp code involved.
+
+Supported tensor encodings: F32, F16, BF16, and Q8_0 (dequantized on
+load: 32-element blocks of f16 scale + int8). Other quantizations are
+rejected with a clear error naming the tensor.
+
+A minimal writer (``write_gguf``) exists for round-trip tests.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+MAGIC = b"GGUF"
+DEFAULT_ALIGNMENT = 32
+
+# Metadata value types (GGUF spec).
+T_U8, T_I8, T_U16, T_I16, T_U32, T_I32, T_F32, T_BOOL = range(8)
+T_STRING, T_ARRAY, T_U64, T_I64, T_F64 = 8, 9, 10, 11, 12
+
+_SCALAR_FMT = {
+    T_U8: "<B", T_I8: "<b", T_U16: "<H", T_I16: "<h",
+    T_U32: "<I", T_I32: "<i", T_F32: "<f", T_U64: "<Q",
+    T_I64: "<q", T_F64: "<d",
+}
+
+# ggml tensor encodings we can decode.
+GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
+_TYPE_NAMES = {GGML_F32: "F32", GGML_F16: "F16", GGML_Q8_0: "Q8_0",
+               GGML_BF16: "BF16"}
+
+
+@dataclass
+class TensorInfo:
+    name: str
+    dims: tuple[int, ...]  # ne order: fastest-varying first
+    ggml_type: int
+    offset: int  # relative to the data section
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        """Numpy (row-major) shape: GGUF dims reversed."""
+        return tuple(reversed(self.dims))
+
+    @property
+    def n_elements(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        b = self.buf[self.pos : self.pos + n]
+        if len(b) != n:
+            raise ValueError("truncated GGUF file")
+        self.pos += n
+        return b
+
+    def scalar(self, fmt: str):
+        return struct.unpack(fmt, self.take(struct.calcsize(fmt)))[0]
+
+    def string(self) -> str:
+        n = self.scalar("<Q")
+        return self.take(n).decode("utf-8")
+
+    def value(self, vtype: int):
+        if vtype in _SCALAR_FMT:
+            v = self.scalar(_SCALAR_FMT[vtype])
+            return v
+        if vtype == T_BOOL:
+            return bool(self.scalar("<B"))
+        if vtype == T_STRING:
+            return self.string()
+        if vtype == T_ARRAY:
+            etype = self.scalar("<I")
+            count = self.scalar("<Q")
+            return [self.value(etype) for _ in range(count)]
+        raise ValueError(f"unknown GGUF metadata type {vtype}")
+
+
+class GGUFFile:
+    """Parsed GGUF: ``metadata`` dict + lazy tensor access."""
+
+    def __init__(self, metadata: dict, tensors: dict[str, TensorInfo],
+                 data: memoryview, alignment: int):
+        self.metadata = metadata
+        self.tensors = tensors
+        self._data = data
+        self.alignment = alignment
+
+    @classmethod
+    def parse(cls, path: str) -> "GGUFFile":
+        with open(path, "rb") as f:
+            buf = f.read()
+        r = _Reader(buf)
+        if r.take(4) != MAGIC:
+            raise ValueError(f"{path} is not a GGUF file")
+        version = r.scalar("<I")
+        if version not in (2, 3):
+            raise ValueError(f"unsupported GGUF version {version}")
+        n_tensors = r.scalar("<Q")
+        n_kv = r.scalar("<Q")
+        metadata = {}
+        for _ in range(n_kv):
+            key = r.string()
+            vtype = r.scalar("<I")
+            metadata[key] = r.value(vtype)
+        tensors: dict[str, TensorInfo] = {}
+        for _ in range(n_tensors):
+            name = r.string()
+            n_dims = r.scalar("<I")
+            dims = tuple(r.scalar("<Q") for _ in range(n_dims))
+            ggml_type = r.scalar("<I")
+            offset = r.scalar("<Q")
+            tensors[name] = TensorInfo(name, dims, ggml_type, offset)
+        align = int(metadata.get("general.alignment", DEFAULT_ALIGNMENT))
+        data_start = (r.pos + align - 1) // align * align
+        return cls(metadata, tensors, memoryview(buf)[data_start:], align)
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Decode one tensor to float32 numpy in row-major shape."""
+        info = self.tensors.get(name)
+        if info is None:
+            raise KeyError(f"GGUF tensor {name!r} not present")
+        n = info.n_elements
+        off = info.offset
+        t = info.ggml_type
+        if t == GGML_F32:
+            raw = np.frombuffer(self._data, np.float32, n, off)
+            return raw.reshape(info.shape)
+        if t == GGML_F16:
+            raw = np.frombuffer(self._data, np.float16, n, off)
+            return raw.astype(np.float32).reshape(info.shape)
+        if t == GGML_BF16:
+            raw = np.frombuffer(self._data, np.uint16, n, off)
+            return (
+                (raw.astype(np.uint32) << 16)
+                .view(np.float32)
+                .reshape(info.shape)
+            )
+        if t == GGML_Q8_0:
+            # 34-byte blocks: f16 scale + 32 int8 values.
+            n_blocks = n // 32
+            raw = np.frombuffer(self._data, np.uint8, n_blocks * 34, off)
+            blocks = raw.reshape(n_blocks, 34)
+            scales = blocks[:, :2].copy().view(np.float16).astype(np.float32)
+            qs = blocks[:, 2:].view(np.int8).astype(np.float32)
+            return (qs * scales).reshape(info.shape)
+        raise ValueError(
+            f"tensor {name!r}: unsupported GGUF encoding "
+            f"{_TYPE_NAMES.get(t, t)} (supported: F32/F16/BF16/Q8_0)"
+        )
+
+
+# ------------------------------------------------------------------ mapping
+def config_from_gguf(g: GGUFFile):
+    """llama.* metadata keys → ModelConfig (reference:
+    ``gguf_metadata.rs`` ContentConfig)."""
+    from .config import ModelConfig
+
+    md = g.metadata
+    arch = md.get("general.architecture", "llama")
+    if arch not in ("llama", "qwen2"):
+        raise ValueError(f"unsupported GGUF architecture {arch!r}")
+    a = arch
+    vocab = md.get(f"{a}.vocab_size")
+    if vocab is None:
+        tokens = md.get("tokenizer.ggml.tokens")
+        vocab = len(tokens) if tokens else 32000
+    heads = md[f"{a}.attention.head_count"]
+    emb = md[f"{a}.embedding_length"]
+    return ModelConfig(
+        vocab_size=int(vocab),
+        hidden_size=int(emb),
+        intermediate_size=int(md[f"{a}.feed_forward_length"]),
+        num_layers=int(md[f"{a}.block_count"]),
+        num_heads=int(heads),
+        num_kv_heads=int(md.get(f"{a}.attention.head_count_kv", heads)),
+        head_dim=int(md[f"{a}.rope.dimension_count"])
+        if f"{a}.rope.dimension_count" in md
+        else None,
+        rope_theta=float(md.get(f"{a}.rope.freq_base", 10000.0)),
+        rms_norm_eps=float(
+            md.get(f"{a}.attention.layer_norm_rms_epsilon", 1e-5)
+        ),
+        max_position_embeddings=int(md.get(f"{a}.context_length", 4096)),
+        tie_word_embeddings="output.weight" not in g.tensors,
+        model_type=a,
+    )
+
+
+def _unpermute_rope(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """Invert llama.cpp's q/k rope permutation: GGUF stores
+    ``w.reshape(H, 2, hd//2, in).swapaxes(1, 2)`` of the HF weight, so
+    the HF layout (which our rope implementation expects) is recovered
+    by the inverse reshape/swap."""
+    out, inner = w.shape
+    hd = out // n_heads
+    return (
+        w.reshape(n_heads, hd // 2, 2, inner)
+        .swapaxes(1, 2)
+        .reshape(out, inner)
+    )
+
+
+def load_params_from_gguf(path: str, cfg=None):
+    """GGUF → (stacked param pytree, ModelConfig) matching
+    ``models/loader.load_params``'s output."""
+    import jax.numpy as jnp
+
+    from .llama import _dtype
+
+    g = GGUFFile.parse(path)
+    if cfg is None:
+        cfg = config_from_gguf(g)
+    dt = _dtype(cfg)
+
+    def linear(name: str) -> np.ndarray:
+        # GGUF stores the torch [out, in] weight; we use x @ W.
+        return g.tensor(name).T
+
+    def qk(name: str, heads: int) -> np.ndarray:
+        return _unpermute_rope(g.tensor(name), heads).T
+
+    layers: dict[str, list] = {k: [] for k in (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+        "w_gate", "w_up", "w_down",
+    )}
+    for i in range(cfg.num_layers):
+        p = f"blk.{i}."
+        layers["attn_norm"].append(g.tensor(p + "attn_norm.weight"))
+        layers["wq"].append(qk(p + "attn_q.weight", cfg.num_heads))
+        layers["wk"].append(qk(p + "attn_k.weight", cfg.num_kv_heads))
+        layers["wv"].append(linear(p + "attn_v.weight"))
+        layers["wo"].append(linear(p + "attn_output.weight"))
+        layers["mlp_norm"].append(g.tensor(p + "ffn_norm.weight"))
+        layers["w_gate"].append(linear(p + "ffn_gate.weight"))
+        layers["w_up"].append(linear(p + "ffn_up.weight"))
+        layers["w_down"].append(linear(p + "ffn_down.weight"))
+
+    params = {
+        "embed": jnp.asarray(g.tensor("token_embd.weight"), dt),
+        "layers": {
+            k: jnp.asarray(np.stack(v), dt) for k, v in layers.items()
+        },
+        "final_norm": jnp.asarray(g.tensor("output_norm.weight"), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(linear("output.weight"), dt)
+    return params, cfg
+
+
+# ------------------------------------------------------------------- writer
+def write_gguf(
+    path: str,
+    metadata: dict,
+    tensors: dict[str, np.ndarray],
+    alignment: int = DEFAULT_ALIGNMENT,
+) -> None:
+    """Minimal GGUF v3 writer (F32 tensors only) for tests and tooling.
+    ``tensors`` values are row-major numpy arrays; dims are written
+    reversed per the spec."""
+
+    def pstr(s: str) -> bytes:
+        b = s.encode("utf-8")
+        return struct.pack("<Q", len(b)) + b
+
+    def pval(v) -> bytes:
+        if isinstance(v, bool):
+            return struct.pack("<IB", T_BOOL, int(v))
+        if isinstance(v, int):
+            return struct.pack("<Iq", T_I64, v)
+        if isinstance(v, float):
+            return struct.pack("<If", T_F32, v)
+        if isinstance(v, str):
+            return struct.pack("<I", T_STRING) + pstr(v)
+        if isinstance(v, list):
+            if v and isinstance(v[0], str):
+                body = b"".join(pstr(x) for x in v)
+                etype = T_STRING
+            else:
+                body = b"".join(struct.pack("<q", int(x)) for x in v)
+                etype = T_I64
+            return (
+                struct.pack("<II", T_ARRAY, etype)
+                + struct.pack("<Q", len(v))
+                + body
+            )
+        raise TypeError(f"unsupported metadata value {v!r}")
+
+    out = bytearray()
+    out += MAGIC
+    out += struct.pack("<I", 3)
+    out += struct.pack("<Q", len(tensors))
+    out += struct.pack("<Q", len(metadata))
+    for k, v in metadata.items():
+        out += pstr(k)
+        out += pval(v)
+    blobs = []
+    offset = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr, np.float32)
+        dims = tuple(reversed(arr.shape))
+        out += pstr(name)
+        out += struct.pack("<I", len(dims))
+        for d in dims:
+            out += struct.pack("<Q", d)
+        out += struct.pack("<I", GGML_F32)
+        out += struct.pack("<Q", offset)
+        blob = arr.tobytes()
+        pad = (-len(blob)) % alignment
+        blobs.append(blob + b"\0" * pad)
+        offset += len(blob) + pad
+    pad = (-len(out)) % alignment
+    out += b"\0" * pad
+    for blob in blobs:
+        out += blob
+    with open(path, "wb") as f:
+        f.write(bytes(out))

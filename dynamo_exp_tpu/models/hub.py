@@ -1,0 +1,77 @@
+"""Model source resolution: local dir, GGUF file, or HuggingFace hub id.
+
+Capability parity with ``/root/reference/lib/llm/src/hub.rs:23-84``
+(``from_hf``: fetch every non-ignored file of a hub repo into the local
+cache and return the directory). TPU pods frequently run with no
+egress, so resolution is cache-first: an already-downloaded snapshot is
+used without touching the network, and a genuine download failure
+produces an actionable error instead of a hang.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+# Reference ignores repo cruft (hub.rs:19) and images (hub.rs:86-93).
+IGNORE_PATTERNS = [
+    ".gitattributes",
+    "LICENSE",
+    "README.md",
+    "*.png",
+    "*.PNG",
+    "*.jpg",
+    "*.JPG",
+    "*.jpeg",
+    "*.JPEG",
+    # GPU-engine formats we never read; keeps 8B downloads lean.
+    "*.bin",
+    "*.pth",
+    "*.onnx",
+]
+
+
+def looks_like_hub_id(name: str) -> bool:
+    """'org/model' shaped, not an existing local path."""
+    if os.path.exists(name):
+        return False
+    parts = name.split("/")
+    return len(parts) == 2 and all(p and not p.startswith(".") for p in parts)
+
+
+def resolve_model_path(name_or_path: str) -> str:
+    """Local dir / .gguf file → itself; hub id → cached snapshot dir,
+    downloading on first use (``hub.rs:23-84``)."""
+    if os.path.isdir(name_or_path) or name_or_path.endswith(".gguf"):
+        return name_or_path
+    if not looks_like_hub_id(name_or_path):
+        raise FileNotFoundError(
+            f"{name_or_path!r} is neither a local path nor an "
+            "org/model HuggingFace id"
+        )
+    from huggingface_hub import snapshot_download
+    from huggingface_hub.errors import LocalEntryNotFoundError
+
+    try:
+        # Cache-first: never touch the network for a model that is
+        # already resident (works fully offline).
+        return snapshot_download(
+            name_or_path,
+            local_files_only=True,
+            ignore_patterns=IGNORE_PATTERNS,
+        )
+    except LocalEntryNotFoundError:
+        pass
+    logger.info("downloading %s from the HuggingFace hub", name_or_path)
+    try:
+        return snapshot_download(
+            name_or_path, ignore_patterns=IGNORE_PATTERNS
+        )
+    except Exception as e:
+        raise RuntimeError(
+            f"could not fetch {name_or_path!r} from the HuggingFace hub "
+            f"({type(e).__name__}: {e}); on an air-gapped host, pre-seed "
+            "the HF cache or pass a local --model-path"
+        ) from e

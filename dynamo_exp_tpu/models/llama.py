@@ -138,27 +138,34 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend):
+def _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend, reduce=None):
     """One transformer layer, shared by the paged and ring paths.
 
     ``attend(q, k, v) -> (attn_out, kv_extra)`` is the only thing that
     differs between them; everything else (norms, projections, rope,
     residuals, SwiGLU) must stay identical or prefill logits silently
     diverge from decode.
+
+    Head counts come from the weight shapes (not the config) so the
+    body works unchanged on tensor-parallel shards, where each rank
+    holds H/tp heads. ``reduce`` (e.g. ``psum`` over the tp axis) is
+    applied to the two row-sharded matmul outputs before the residual
+    adds; None means the weights are unsharded.
     """
     B, T = x.shape[:2]
     hd = cfg.head_dim_
+    red = reduce if reduce is not None else (lambda y: y)
     h = rms_norm(x, lp["attn_norm"], eps)
-    q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, hd)
-    k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, hd)
-    v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, hd)
+    q = (h @ lp["wq"]).reshape(B, T, lp["wq"].shape[-1] // hd, hd)
+    k = (h @ lp["wk"]).reshape(B, T, lp["wk"].shape[-1] // hd, hd)
+    v = (h @ lp["wv"]).reshape(B, T, lp["wv"].shape[-1] // hd, hd)
     q = apply_rope(q, rope_pos, inv_freq)
     k = apply_rope(k, rope_pos, inv_freq)
     attn, kv_extra = attend(q, k, v)
-    x = x + attn.reshape(B, T, cfg.num_heads * hd) @ lp["wo"]
+    x = x + red(attn.reshape(B, T, -1) @ lp["wo"])
     h = rms_norm(x, lp["mlp_norm"], eps)
     gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    x = x + red((gate * (h @ lp["w_up"])) @ lp["w_down"])
     return x, kv_extra
 
 
@@ -182,6 +189,7 @@ def forward(
     mesh=None,
     interpret: bool = False,
     last_positions: jnp.ndarray | None = None,
+    token_embeds: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill or decode by bucket shape).
 
@@ -219,7 +227,15 @@ def forward(
     page_ids = page_table[batch_idx, page_in_seq]  # [B*T]
     offsets = safe_pos % ps
 
-    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    # ``token_embeds`` ([B, T, D]) overrides the id lookup — the
+    # multimodal seam: image (or other modality) features projected to
+    # hidden size enter as soft tokens (reference capability:
+    # examples/multimodal encode worker → LLM worker handoff).
+    x = (
+        token_embeds.astype(params["embed"].dtype)
+        if token_embeds is not None
+        else jnp.take(params["embed"], tokens, axis=0)
+    )  # [B, T, D]
     rope_pos = jnp.maximum(positions, 0)
 
     use_pallas = attn_impl == "pallas" and T == 1
@@ -313,8 +329,10 @@ def forward_ring_prefill(
     positions: jnp.ndarray,  # [B, T] int32, -1 for padding
     mesh,
     sp_axis: str = "sp",
+    tp_axis: str = "tp",
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Sequence-parallel long-context prefill via ring attention.
+    """Sequence-parallel long-context prefill via ring attention,
+    composable with tensor parallelism.
 
     A capability beyond the reference (SURVEY.md §5: it has no context
     parallelism of its own): the sequence axis is sharded over ``sp``,
@@ -323,12 +341,17 @@ def forward_ring_prefill(
     *activation* memory scales 1/sp, so prefills longer than one chip's
     HBM limit become possible.
 
-    Params are fully **replicated** inside this path (``in_specs=P()``):
-    it is sequence-parallel only — the layer body has no psum, so
-    tp-sharded params would produce partial sums. Combining sp with tp
-    (tp-sharded projections + ring over sp) is a planned extension.
-    Returns (logits [B,T,V], k, v [L,B,T,Hkv,D]), all sharded over T —
-    the caller scatters K/V into its page pool or hands them to the
+    With a mesh whose ``tp`` axis is >1, projections are megatron-
+    sharded over heads/ffn on top of the sequence ring: each (sp, tp)
+    rank computes its local heads' attention over its sequence shard,
+    row-sharded matmuls psum over ``tp``, and the embedding is
+    vocab-sharded with a masked-lookup + psum. Requires
+    ``num_kv_heads % tp == 0``.
+
+    Returns (logits [B,T,V], k, v [L,B,T,Hkv,D]). Logits shard over T
+    and are full-vocab on every tp rank (the vocab-sharded locals are
+    all-gathered); K/V shard over T and, when tp>1, over kv heads — the
+    caller scatters K/V into its page pool or hands them to the
     disaggregation transfer plane.
     """
     from functools import partial as _partial
@@ -338,23 +361,58 @@ def forward_ring_prefill(
     from ..ops.ring_attention import ring_attention
 
     sp = mesh.shape[sp_axis]
+    tp = mesh.shape.get(tp_axis, 1)
     B, T = tokens.shape
     if T % sp:
         raise ValueError(f"seq len {T} not divisible by sp={sp}")
+    if cfg.num_kv_heads % tp:
+        raise ValueError(f"kv heads {cfg.num_kv_heads} not divisible by tp={tp}")
     hd = cfg.head_dim_
     eps = cfg.rms_norm_eps
     inv_freq = rope_frequencies(hd, cfg.rope_theta, cfg.rope_scaling)
     seq = P(None, sp_axis)
 
+    if tp == 1:
+        param_specs = jax.tree.map(lambda _: P(), params)
+        kv_spec = P(None, None, sp_axis)
+        reduce = None
+    else:
+        param_specs = param_shardings(cfg, tp_axis)
+        kv_spec = P(None, None, sp_axis, tp_axis)
+
+        def reduce(y):
+            return jax.lax.psum(y, tp_axis)
+
+    def embed_lookup(table, tokens_l):
+        if tp == 1:
+            return jnp.take(table, tokens_l, axis=0)
+        # Vocab-sharded table: each rank resolves its slice, psum fills
+        # the rest (standard megatron embedding).
+        local_v = table.shape[0]
+        start = jax.lax.axis_index(tp_axis) * local_v
+        ids = tokens_l - start
+        ok = (ids >= 0) & (ids < local_v)
+        x = jnp.take(table, jnp.clip(ids, 0, local_v - 1), axis=0)
+        x = jnp.where(ok[..., None], x, 0)
+        return jax.lax.psum(x, tp_axis)
+
+    def final_logits(params_l, x):
+        # The tp==1 path IS _final_logits; with tp>1 both head choices
+        # produce vocab-sharded locals, all-gathered to full V.
+        local = _final_logits(params_l, cfg, x, eps)
+        if tp == 1:
+            return local
+        return jax.lax.all_gather(local, tp_axis, axis=local.ndim - 1, tiled=True)
+
     @_partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), seq, seq),
-        out_specs=(seq, P(None, None, sp_axis), P(None, None, sp_axis)),
+        in_specs=(param_specs, seq, seq),
+        out_specs=(seq, kv_spec, kv_spec),
         check_vma=False,
     )
-    def fwd(params, tokens_l, pos_l):
-        x = jnp.take(params["embed"], tokens_l, axis=0)
+    def fwd(params_l, tokens_l, pos_l):
+        x = embed_lookup(params_l["embed"], tokens_l)
         rope_pos = jnp.maximum(pos_l, 0)
 
         def layer(x, lp):
@@ -362,9 +420,11 @@ def forward_ring_prefill(
                 attn = ring_attention(q, k, v, pos_l, pos_l, sp_axis, sp)
                 return attn, (k, v)
 
-            return _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend)
+            return _attn_mlp_layer(
+                x, lp, cfg, inv_freq, rope_pos, eps, attend, reduce=reduce
+            )
 
-        x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
-        return _final_logits(params, cfg, x, eps), ks, vs
+        x, (ks, vs) = jax.lax.scan(layer, x, params_l["layers"])
+        return final_logits(params_l, x), ks, vs
 
     return fwd(params, tokens, positions)

@@ -1,4 +1,11 @@
 from .mesh import build_mesh, largest_tp, shard, shard_pytree, single_device_mesh
+from .multihost import (
+    MultiNodeConfig,
+    bringup,
+    detect_host_ip,
+    initialize_multihost,
+    resolve_leader_addr,
+)
 
 __all__ = [
     "build_mesh",
@@ -6,4 +13,9 @@ __all__ = [
     "shard",
     "shard_pytree",
     "largest_tp",
+    "MultiNodeConfig",
+    "bringup",
+    "detect_host_ip",
+    "initialize_multihost",
+    "resolve_leader_addr",
 ]

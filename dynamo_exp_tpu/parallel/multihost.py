@@ -1,0 +1,133 @@
+"""Multi-host engine bring-up: one global JAX runtime across nodes.
+
+Reference parity: the multi-node engine bootstrap —
+``/root/reference/lib/llm/src/engines.rs:41-50`` (``MultiNodeConfig``
+num_nodes/node_rank/leader_addr), ``/root/reference/lib/engines/
+vllm0_7/src/ray.rs:66-107`` (leader starts the cluster head, followers
+join it), ``/root/reference/launch/dynamo-run/src/net.rs:1-226``
+(primary-interface leader address detection).
+
+TPU-native shape: there is no ray/MPI layer — ``jax.distributed``
+forms the global runtime (one process per host, the process's local
+chips join a global device list), and multi-chip execution stays
+declarative: ``build_mesh`` over ``jax.devices()`` now spans hosts, and
+the same ``pjit``/``shard_map`` programs run with XLA routing
+collectives over ICI within a slice and DCN across slices. Leader
+address discovery is either explicit (``leader_addr``) or through the
+control plane: rank 0 publishes its address under a well-known KV key
+and the other ranks watch for it (the reference's ray head/follower
+handshake, minus ray).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+LEADER_KEY = "multihost/leader"
+DEFAULT_DIST_PORT = 9911
+
+
+@dataclass
+class MultiNodeConfig:
+    """How this process fits into the multi-host engine.
+
+    Mirrors ``engines.rs:41-50``: ``num_nodes`` (world size),
+    ``node_rank`` (this process), ``leader_addr`` ("host:port" of rank
+    0's jax.distributed coordinator; None = discover via the control
+    plane or, for rank 0, self-derive and publish).
+    """
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: str | None = None
+    dist_port: int = DEFAULT_DIST_PORT
+
+    @property
+    def is_multi_node(self) -> bool:
+        return self.num_nodes > 1
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+
+def detect_host_ip() -> str:
+    """Primary-interface address (reference: ``net.rs`` walks netlink
+    for the default route's interface; the UDP-connect trick gets the
+    same answer portably without sending a packet)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+async def resolve_leader_addr(
+    cfg: MultiNodeConfig, discovery=None, timeout_s: float = 120.0
+) -> str:
+    """Rank 0 derives + publishes its coordinator address; other ranks
+    read it from the control plane (etcd-equivalent KV)."""
+    if cfg.leader_addr:
+        return cfg.leader_addr
+    if cfg.is_leader:
+        addr = f"{detect_host_ip()}:{cfg.dist_port}"
+        if discovery is not None:
+            await discovery.kv_put(LEADER_KEY, addr.encode())
+        return addr
+    if discovery is None:
+        raise ValueError(
+            "follower needs --dist-leader or a coordinator to discover it"
+        )
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while asyncio.get_running_loop().time() < deadline:
+        value = await discovery.kv_get(LEADER_KEY)
+        if value:
+            return value.decode()
+        await asyncio.sleep(0.25)
+    raise TimeoutError(f"no leader address under {LEADER_KEY!r}")
+
+
+def initialize_multihost(
+    cfg: MultiNodeConfig, leader_addr: str | None = None
+) -> None:
+    """Join the global JAX runtime. After this, ``jax.devices()`` spans
+    every node and ``build_mesh`` can lay a global mesh; per-process
+    data feeding uses ``jax.process_index()``."""
+    import jax
+
+    if not cfg.is_multi_node:
+        return
+    addr = leader_addr or cfg.leader_addr
+    if not addr:
+        raise ValueError("multi-node init needs the leader address")
+    logger.info(
+        "joining global runtime: rank %d/%d via %s",
+        cfg.node_rank,
+        cfg.num_nodes,
+        addr,
+    )
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=cfg.num_nodes,
+        process_id=cfg.node_rank,
+    )
+
+
+async def bringup(cfg: MultiNodeConfig, discovery=None) -> None:
+    """The full bring-up: resolve the leader, join the runtime."""
+    if not cfg.is_multi_node:
+        return
+    addr = await resolve_leader_addr(cfg, discovery)
+    # jax.distributed.initialize blocks until every rank dials in; run
+    # it off-loop so a supervisor's event loop stays responsive.
+    await asyncio.get_running_loop().run_in_executor(
+        None, initialize_multihost, cfg, addr
+    )

@@ -1,0 +1,45 @@
+"""Request templates: server-side defaults for incoming OpenAI requests.
+
+Capability parity with ``/root/reference/lib/llm/src/request_template.rs``
+(+ its application in ``launch/dynamo-run``'s HTTP input): a JSON file
+of defaults (model, temperature, max_completion_tokens) applied to any
+request that leaves those fields unset, so clients can POST minimal
+bodies against a curated deployment.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+
+@dataclass
+class RequestTemplate:
+    model: str = ""
+    temperature: float | None = None
+    max_completion_tokens: int | None = None
+
+    @classmethod
+    def load(cls, path: str) -> "RequestTemplate":
+        with open(path) as f:
+            data = json.load(f)
+        return cls(
+            model=data.get("model", ""),
+            temperature=data.get("temperature"),
+            max_completion_tokens=data.get("max_completion_tokens"),
+        )
+
+    def apply(self, request: dict) -> dict:
+        """Fill unset fields in an OpenAI request dict (in place +
+        returned). Explicit client values always win."""
+        if self.model and not request.get("model"):
+            request["model"] = self.model
+        if self.temperature is not None and request.get("temperature") is None:
+            request["temperature"] = self.temperature
+        if self.max_completion_tokens is not None:
+            if (
+                request.get("max_tokens") is None
+                and request.get("max_completion_tokens") is None
+            ):
+                request["max_completion_tokens"] = self.max_completion_tokens
+        return request

@@ -66,6 +66,21 @@ def parse_args(argv: list[str]):
     p.add_argument("--kv-dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument("--max-tokens", type=int, default=256, help="default completion cap")
     p.add_argument("--echo-token-delay-ms", type=float, default=0.0)
+    p.add_argument("--request-template", default="",
+                   help="JSON file of request defaults (model/temperature/"
+                        "max_completion_tokens), reference request_template.rs")
+    # Multi-host engine (reference: MultiNodeConfig, engines.rs:41-50 +
+    # ray.rs leader/follower join): every node runs this CLI with the
+    # same flags plus its own --node-rank; rank 0 is the leader.
+    p.add_argument("--num-nodes", type=int, default=1,
+                   help="hosts in the engine's global JAX runtime")
+    p.add_argument("--node-rank", type=int, default=0,
+                   help="this host's rank (0 = leader)")
+    p.add_argument("--dist-leader", default="",
+                   help="rank-0 host:port for jax.distributed; empty = "
+                        "leader self-derives and publishes via --coordinator")
+    p.add_argument("--dist-port", type=int, default=9911,
+                   help="port the leader binds for jax.distributed")
     opts = p.parse_args(rest)
     opts.input, opts.output = io["in"], io["out"]
     return opts
@@ -90,26 +105,41 @@ def build_tpu_engine(opts):
     mdc = None
     params = None
     if opts.model_path:
-        mcfg = ModelConfig.from_pretrained(opts.model_path)
-        mdc = ModelDeploymentCard.from_local_path(
-            opts.model_path, opts.model_name or None
-        )
-        mdc.kv_cache_block_size = opts.page_size
-        has_weights = any(
-            f.endswith(".safetensors") for f in os.listdir(opts.model_path)
-        )
-        if opts.random_weights:
-            pass  # explicit opt-in: serve random weights (tests, smoke)
-        elif has_weights:
-            from .models.loader import load_params
+        from .models.hub import resolve_model_path
 
-            params, mcfg = load_params(opts.model_path, mcfg)
+        opts.model_path = resolve_model_path(opts.model_path)
+        if opts.model_path.endswith(".gguf"):
+            from .models.gguf import config_from_gguf, load_params_from_gguf
+
+            if opts.random_weights:
+                from .models.gguf import GGUFFile
+
+                mcfg = config_from_gguf(GGUFFile.parse(opts.model_path))
+            else:
+                params, mcfg = load_params_from_gguf(opts.model_path)
         else:
-            # Never silently serve garbage under a real model's name.
-            raise SystemExit(
-                f"no .safetensors weights in {opts.model_path}; "
-                "pass --random-weights to serve a random-initialized model"
+            mcfg = ModelConfig.from_pretrained(opts.model_path)
+            mdc = ModelDeploymentCard.from_local_path(
+                opts.model_path, opts.model_name or None
             )
+            mdc.kv_cache_block_size = opts.page_size
+            has_weights = any(
+                f.endswith(".safetensors")
+                for f in os.listdir(opts.model_path)
+            )
+            if opts.random_weights:
+                pass  # explicit opt-in: serve random weights (tests, smoke)
+            elif has_weights:
+                from .models.loader import load_params
+
+                params, mcfg = load_params(opts.model_path, mcfg)
+            else:
+                # Never silently serve garbage under a real model's name.
+                raise SystemExit(
+                    f"no .safetensors weights in {opts.model_path}; "
+                    "pass --random-weights to serve a random-initialized "
+                    "model"
+                )
     elif opts.preset:
         mcfg = PRESETS[opts.preset]
     else:
@@ -165,9 +195,16 @@ async def remote_core(opts, drt, block_size: int):
 
 def require_mdc(opts):
     from .model_card import ModelDeploymentCard
+    from .models.hub import resolve_model_path
 
     if not opts.model_path:
         raise SystemExit(f"in={opts.input} with out={opts.output} needs --model-path")
+    opts.model_path = resolve_model_path(opts.model_path)
+    if opts.model_path.endswith(".gguf"):
+        raise SystemExit(
+            "this node shape needs a tokenizer/chat template; GGUF files "
+            "carry weights only here — pass an HF-style --model-path dir"
+        )
     mdc = ModelDeploymentCard.from_local_path(opts.model_path, opts.model_name or None)
     mdc.kv_cache_block_size = opts.page_size
     return mdc
@@ -196,7 +233,14 @@ async def run_http(opts, drt, core, full, mdc):
     from .http import HttpService
     from .http.discovery import ModelWatcher
 
-    svc = HttpService(host=opts.http_host, port=opts.http_port)
+    template = None
+    if opts.request_template:
+        from .protocols.request_template import RequestTemplate
+
+        template = RequestTemplate.load(opts.request_template)
+    svc = HttpService(
+        host=opts.http_host, port=opts.http_port, request_template=template
+    )
     watcher = None
     kv_router = None
     if opts.output.startswith("dyn://") and not opts.model_path:
@@ -395,6 +439,21 @@ async def main_async(opts) -> None:
     if opts.coordinator:
         cfg.coordinator_endpoint = opts.coordinator
     drt = DistributedRuntime(config=cfg)
+
+    if opts.num_nodes > 1:
+        # Join the global JAX runtime before any engine touches a
+        # device: after this, jax.devices() spans every node.
+        from .parallel.multihost import MultiNodeConfig, bringup
+
+        await bringup(
+            MultiNodeConfig(
+                num_nodes=opts.num_nodes,
+                node_rank=opts.node_rank,
+                leader_addr=opts.dist_leader or None,
+                dist_port=opts.dist_port,
+            ),
+            discovery=drt.discovery if opts.coordinator else None,
+        )
 
     core, full, mdc, tpu_engine = build_output(opts, drt)
     try:

@@ -34,9 +34,18 @@ class NoInstancesError(ConnectionError):
 class PushRouter(AsyncEngine[dict, Any]):
     """Routes each request to one live instance of a remote endpoint."""
 
-    def __init__(self, client: Client, mode: RouterMode = RouterMode.RANDOM):
+    def __init__(
+        self,
+        client: Client,
+        mode: RouterMode = RouterMode.RANDOM,
+        ready_wait_s: float = 0.0,
+    ):
         self.client = client
         self.mode = mode
+        # >0: a request arriving before any instance is discovered waits
+        # this long for one instead of failing (ingress/graph startup
+        # races); 0 keeps the strict fail-fast default.
+        self.ready_wait_s = ready_wait_s
         self._rr = itertools.count()
 
     def _pick(self, request: dict) -> InstanceInfo:
@@ -65,6 +74,11 @@ class PushRouter(AsyncEngine[dict, Any]):
         self, request: dict, context: AsyncEngineContext | None = None
     ) -> ResponseStream[Any]:
         ctx = context or AsyncEngineContext()
+        if not self.client.instances and self.ready_wait_s > 0:
+            try:
+                await self.client.wait_for_instances(1, self.ready_wait_s)
+            except TimeoutError:
+                pass  # fall through to the strict error below
         instance = self._pick(request)
         request = {k: v for k, v in request.items() if k != "_worker_instance_id"}
         frames = await self.client.generate_to(instance, request, ctx)

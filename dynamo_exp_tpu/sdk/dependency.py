@@ -35,6 +35,15 @@ class DependencyClient:
     async def round_robin(self, request: dict) -> AsyncIterator[Any]:
         return await self.generate(request)
 
+    async def wait_ready(self, n: int = 1, timeout_s: float | None = None) -> None:
+        """Block until ``n`` live instances exist (graph services boot
+        concurrently; dependents gate first use on this)."""
+        if len(self._router.client.instance_ids()) >= n:
+            return
+        await self._router.client.wait_for_instances(
+            n, timeout_s if timeout_s is not None else self.ready_timeout_s
+        )
+
     async def direct(self, request: dict, instance_id: int) -> AsyncIterator[Any]:
         return await self._router.generate_direct(request, instance_id)
 

@@ -169,10 +169,16 @@ class Supervisor:
             if self.failed is not None and not self.failed.done():
                 self.failed.set_exception(exc)
 
+    def _initial_workers(self, spec) -> int:
+        """YAML ``ServiceArgs: {workers: N}`` overrides the decorator's
+        count (reference parity: per-service ServiceArgs in configs)."""
+        svc_args = self.config.get(spec.name).get("ServiceArgs") or {}
+        return int(svc_args.get("workers", spec.workers))
+
     async def start_initial(self) -> None:
         self.failed = asyncio.get_running_loop().create_future()
         for spec in self.specs.values():
-            for _ in range(spec.workers):
+            for _ in range(self._initial_workers(spec)):
                 if not await self.add_worker(spec.name):
                     raise RuntimeError(f"failed to start {spec.name}")
 

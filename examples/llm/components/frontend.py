@@ -1,0 +1,82 @@
+"""Frontend: the OpenAI HTTP ingress of the flagship graphs.
+
+Reference parity: ``/root/reference/examples/llm/components/frontend.py``
+(HTTP server bound to the Processor). The aiohttp OpenAI service runs
+inside this service's process; chat/completion requests forward to the
+Processor over the request plane and stream back as SSE.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from dynamo_exp_tpu.sdk import (
+    async_on_start,
+    depends,
+    dynamo_context,
+    service,
+)
+
+from .processor import Processor
+
+logger = logging.getLogger(__name__)
+
+
+class _RemoteOpenAIEngine:
+    """AsyncEngine adapter: OpenAI request dict → the Processor's
+    ``generate`` endpoint → OpenAI chunk stream."""
+
+    def __init__(self, dep):
+        self.dep = dep
+
+    async def generate(self, request: dict, context=None):
+        from dynamo_exp_tpu.protocols.openai import (
+            ChatCompletionChunk,
+            CompletionChunk,
+        )
+        from dynamo_exp_tpu.runtime.engine import (
+            AsyncEngineContext,
+            ResponseStream,
+        )
+
+        ctx = context or AsyncEngineContext()
+        stream = await self.dep.generate({"request": request})
+
+        async def gen():
+            # The HTTP layer streams pydantic objects (model_dump at the
+            # SSE boundary); revalidate the Processor's wire dicts.
+            async for chunk in stream:
+                cls = (
+                    ChatCompletionChunk
+                    if chunk.get("object") == "chat.completion.chunk"
+                    else CompletionChunk
+                )
+                yield cls.model_validate(chunk)
+
+        return ResponseStream(gen(), ctx)
+
+
+@service(dynamo={"namespace": "dynamo"})
+class Frontend:
+    processor = depends(Processor)
+
+    served_model_name: str = "model"
+    port: int = 8000
+    host: str = "0.0.0.0"
+
+    def __init__(self):
+        self.service = None
+
+    @async_on_start
+    async def start_http(self) -> None:
+        from dynamo_exp_tpu.http import HttpService
+
+        self.service = HttpService(host=self.host, port=self.port)
+        engine = _RemoteOpenAIEngine(self.processor)
+        self.service.manager.add_chat_model(self.served_model_name, engine)
+        self.service.manager.add_completion_model(
+            self.served_model_name, engine
+        )
+        port = await self.service.start()
+        logger.info("frontend listening on %s:%d", self.host, port)
+        print(f"frontend on http://{self.host}:{port}", flush=True)

@@ -1,0 +1,77 @@
+"""PrefillTpuWorker: the prefill fleet of the disaggregated graphs.
+
+Reference parity:
+``/root/reference/examples/llm/components/prefill_worker.py`` (pull the
+prefill queue, compute, write KV to the decode worker). TPU-native: the
+queue rides the coordinator, KV pages travel over the TCP transfer
+plane, and the worker registers a presence endpoint so the planner can
+count the fleet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_exp_tpu.sdk import async_on_start, dynamo_context, endpoint, service
+
+logger = logging.getLogger(__name__)
+
+
+@service(dynamo={"namespace": "dynamo"}, resources={"tpu": 1})
+class PrefillTpuWorker:
+    model_path: str = ""
+    served_model_name: str = ""
+    random_weights: bool = False
+    page_size: int = 16
+    num_pages: int = 0
+    max_model_len: int = 2048
+    kv_dtype: str = "bfloat16"
+
+    def __init__(self):
+        self.worker = None
+        self._run_task = None
+
+    @async_on_start
+    async def start_engine(self) -> None:
+        from dynamo_exp_tpu.disagg import PrefillWorker
+        from dynamo_exp_tpu.models.hub import resolve_model_path
+        from dynamo_exp_tpu.planner.planner import prefill_queue_name
+        from dynamo_exp_tpu.run import build_tpu_engine
+        from dynamo_exp_tpu.runtime.runtime import CancellationToken
+
+        drt = dynamo_context["runtime"]
+
+        class _Opts:
+            model_path = resolve_model_path(self.model_path)
+            model_name = self.served_model_name
+            preset = ""
+            random_weights = self.random_weights
+            page_size = self.page_size
+            num_pages = self.num_pages
+            max_decode_slots = 2  # prefill-only: decode slots are parking
+            max_model_len = self.max_model_len
+            kv_dtype = self.kv_dtype
+            host_cache_pages = 0
+            max_tokens = 256
+            tp = 1
+
+        engine, _mdc = build_tpu_engine(_Opts)
+        engine.start()
+        queue = drt.work_queue(
+            prefill_queue_name(self.served_model_name or "model")
+        )
+        # No component= here: the SDK already serves this service's
+        # @endpoint("pull") for presence — a second registration would
+        # double-count the fleet.
+        self.worker = PrefillWorker(engine, queue, CancellationToken())
+        self._run_task = asyncio.ensure_future(self.worker.run())
+
+    # The planner counts the fleet through this presence endpoint; the
+    # actual work arrives through the queue, never pushed requests.
+    @endpoint("pull")
+    async def pull(self, request: dict):
+        yield {
+            "served": self.worker.served if self.worker else 0,
+            "failed": self.worker.failed if self.worker else 0,
+        }

@@ -1,0 +1,84 @@
+"""Processor: tokenize, route, detokenize — the OpenAI-level middle tier.
+
+Reference parity: ``/root/reference/examples/llm/components/processor.py``
+(chat template + tokenization, route to workers, stream deltas back).
+Here it composes the real stack: OpenAIPreprocessor → Backend
+(incremental detokenize + stop jail) → routed core over the TpuWorker
+fleet (round-robin or KV-aware per config).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from dynamo_exp_tpu.sdk import (
+    async_on_start,
+    depends,
+    dynamo_context,
+    endpoint,
+    service,
+)
+
+from .worker import TpuWorker
+
+logger = logging.getLogger(__name__)
+
+
+@service(dynamo={"namespace": "dynamo"})
+class Processor:
+    # Graph edge: serving this processor launches the worker fleet; the
+    # actual request routing goes through build_routed_core below (the
+    # depends client is round-robin-only).
+    workers = depends(TpuWorker)
+
+    model_path: str = ""
+    served_model_name: str = ""
+    router: str = "round-robin"  # random | round-robin | kv
+    page_size: int = 16
+
+    def __init__(self):
+        self.engine = None
+        self._kv_router = None
+
+    @async_on_start
+    async def build(self) -> None:
+        from dynamo_exp_tpu.http.service import build_pipeline_engine
+        from dynamo_exp_tpu.kv_router.router import build_routed_core
+        from dynamo_exp_tpu.model_card import ModelDeploymentCard
+        from dynamo_exp_tpu.models.hub import resolve_model_path
+        from dynamo_exp_tpu.runtime.push_router import RouterMode
+        from dynamo_exp_tpu.sdk.service import get_spec
+
+        drt = dynamo_context["runtime"]
+        path = resolve_model_path(self.model_path)
+        mdc = ModelDeploymentCard.from_local_path(
+            path, self.served_model_name or None
+        )
+        mdc.kv_cache_block_size = self.page_size
+        spec = get_spec(TpuWorker)
+        ep = (
+            drt.namespace(spec.namespace)
+            .component(spec.component_name)
+            .endpoint("generate")
+        )
+        mode = {
+            "random": RouterMode.RANDOM,
+            "round-robin": RouterMode.ROUND_ROBIN,
+            "kv": RouterMode.KV,
+        }[self.router]
+        core, self._kv_router = await build_routed_core(
+            ep, mode, mdc.kv_cache_block_size
+        )
+        self.engine = build_pipeline_engine(mdc, core)
+
+    @endpoint()
+    async def generate(self, request: dict):
+        """{"request": <OpenAI dict>} in, OpenAI chunk dicts out."""
+        # Graph services boot concurrently; gate the first request on
+        # the worker fleet being discoverable instead of erroring.
+        await self.workers.wait_ready(1, timeout_s=120.0)
+        stream = await self.engine.generate(request.get("request", request))
+        async for chunk in stream:
+            # Pydantic chunk objects → dicts for the wire; the Frontend
+            # re-validates them on its side.
+            yield chunk.model_dump(exclude_none=True)

@@ -1,0 +1,138 @@
+"""TpuWorker: the engine service of the flagship graphs.
+
+Reference parity: ``/root/reference/examples/llm/components/worker.py``
+(VllmWorker: engine behind a ``generate`` endpoint, KV events, load
+metrics, optional remote-prefill offload decision). TPU-native: the
+in-process continuous-batching engine, configured through ServiceConfig.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_exp_tpu.sdk import (
+    async_on_start,
+    dynamo_context,
+    endpoint,
+    service,
+    stats_handler,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@service(dynamo={"namespace": "dynamo"}, resources={"tpu": 1})
+class TpuWorker:
+    """Decode (or aggregated) engine worker."""
+
+    # ServiceConfig-overridable (configs/*.yaml).
+    model_path: str = ""
+    served_model_name: str = ""
+    random_weights: bool = False
+    max_decode_slots: int = 8
+    page_size: int = 16
+    num_pages: int = 0  # 0 = auto
+    max_model_len: int = 2048
+    kv_dtype: str = "bfloat16"
+    # "none" = aggregated; "decode" = offload long prefills to the
+    # prefill fleet through the work queue + KV transfer plane.
+    disagg_mode: str = "none"
+    max_local_prefill_length: int = 1000
+
+    def __init__(self):
+        self.engine = None
+        self.serving = None
+        self._kv_pub = None
+        self._receiver = None
+        self._watcher = None
+
+    @async_on_start
+    async def start_engine(self) -> None:
+        from dynamo_exp_tpu.local_model import register_llm
+        from dynamo_exp_tpu.models.hub import resolve_model_path
+        from dynamo_exp_tpu.run import build_tpu_engine
+
+        drt = dynamo_context["runtime"]
+        component = dynamo_context["component"]
+
+        class _Opts:  # the CLI's engine builder, driven by ServiceConfig
+            model_path = resolve_model_path(self.model_path)
+            model_name = self.served_model_name
+            preset = ""
+            random_weights = self.random_weights
+            page_size = self.page_size
+            num_pages = self.num_pages
+            max_decode_slots = self.max_decode_slots
+            max_model_len = self.max_model_len
+            kv_dtype = self.kv_dtype
+            host_cache_pages = 0
+            max_tokens = 256
+            tp = 1
+
+        self.engine, mdc = build_tpu_engine(_Opts)
+        self.engine.start()
+        self.serving = self.engine
+        if self.disagg_mode == "decode":
+            from dynamo_exp_tpu.disagg import (
+                DisaggConfig,
+                DisaggConfigWatcher,
+                DisaggDecodeEngine,
+                KvPageReceiver,
+            )
+            from dynamo_exp_tpu.planner.planner import prefill_queue_name
+
+            self._receiver = KvPageReceiver()
+            await self._receiver.start()
+            self._watcher = DisaggConfigWatcher(
+                drt.discovery,
+                mdc.display_name if mdc else "model",
+                default=DisaggConfig(
+                    max_local_prefill_length=self.max_local_prefill_length
+                ),
+            )
+            await self._watcher.start()
+            queue = drt.work_queue(
+                prefill_queue_name(self.served_model_name or "model")
+            )
+            self.serving = DisaggDecodeEngine(
+                self.engine, queue, self._receiver, self._watcher
+            )
+        if mdc is not None:
+            await register_llm(
+                drt,
+                component.endpoint("generate"),
+                self.model_path,
+                self.served_model_name or None,
+                kv_cache_block_size=self.page_size,
+            )
+        # KV events → the router index (kv routing mode). The endpoint
+        # instance id only exists once serving starts (after this hook),
+        # so wire the publisher from a deferred task.
+        from dynamo_exp_tpu.kv_router.publisher import KvEventPublisher
+
+        loop = asyncio.get_running_loop()
+
+        async def wire_kv_events():
+            for _ in range(200):
+                iid = dynamo_context["instance_ids"].get("generate")
+                if iid is not None:
+                    self._kv_pub = KvEventPublisher(
+                        drt.event_plane, component.path, iid, loop
+                    )
+                    self.engine.kv.event_cb = self._kv_pub.engine_callback()
+                    return
+                await asyncio.sleep(0.05)
+            logger.warning("generate endpoint never served; no KV events")
+
+        self._kv_task = asyncio.ensure_future(wire_kv_events())
+
+    @endpoint()
+    async def generate(self, request: dict):
+        stream = await self.serving.generate(request)
+        async for item in stream:
+            yield item
+
+    @stats_handler
+    def stats(self) -> dict:
+        return self.engine.metrics() if self.engine else {}

@@ -1,0 +1,13 @@
+"""Aggregated serving: Frontend → Processor → TpuWorker (round-robin).
+
+Reference parity: ``/root/reference/examples/llm/graphs/agg.py``. Serve:
+
+    python -m dynamo_exp_tpu.sdk.serve examples.llm.graphs.agg:Frontend \
+        -f examples/llm/configs/agg.yaml --start-coordinator
+"""
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+__all__ = ["Frontend", "Processor", "TpuWorker"]

@@ -1,0 +1,17 @@
+"""Aggregated serving with KV-aware routing.
+
+Reference parity: ``/root/reference/examples/llm/graphs/agg_router.py``
+(Frontend → Processor → Router → Worker). The KV router is embedded in
+the Processor (``router: kv`` in the config selects it); the worker
+fleet publishes KV events that feed its index.
+
+    python -m dynamo_exp_tpu.sdk.serve \
+        examples.llm.graphs.agg_router:Frontend \
+        -f examples/llm/configs/agg_router.yaml --start-coordinator
+"""
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+__all__ = ["Frontend", "Processor", "TpuWorker"]

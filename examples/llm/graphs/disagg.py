@@ -1,0 +1,31 @@
+"""Disaggregated serving: decode fleet + remote prefill fleet.
+
+Reference parity: ``/root/reference/examples/llm/graphs/disagg.py``
+(Frontend → Processor → Worker ⇢ PrefillWorker). The decode worker's
+``disagg_mode: decode`` config routes long uncached prefills through
+the work queue to the prefill fleet; KV pages come back over the TCP
+transfer plane.
+
+    python -m dynamo_exp_tpu.sdk.serve examples.llm.graphs.disagg:Graph \
+        -f examples/llm/configs/disagg.yaml --start-coordinator
+"""
+
+from dynamo_exp_tpu.sdk import depends, service
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.prefill_worker import PrefillTpuWorker
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+
+@service(dynamo={"namespace": "dynamo"})
+class Graph:
+    """Root tying the HTTP ingress to both fleets. The edges exist for
+    graph discovery (the serve CLI launches the dependency closure);
+    neither client is ever called."""
+
+    frontend = depends(Frontend)
+    prefill = depends(PrefillTpuWorker, endpoint="pull")
+
+
+__all__ = ["Graph", "Frontend", "Processor", "TpuWorker", "PrefillTpuWorker"]

@@ -1,0 +1,91 @@
+"""EncodeWorker: image → embedding service of the multimodal graph.
+
+Reference parity:
+``/root/reference/examples/multimodal/components/encode_worker.py:21-60``
+(vision tower + projector on its own GPU, streaming image features to
+the LLM worker). TPU-native: a JAX patch encoder — patchify, linear
+projection, one attention-free mixing layer — standing in for a full
+vision tower; the seam it feeds (``image_features`` consumed as soft
+tokens via ``models/llama.forward(token_embeds=...)``) is the real one.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+
+import numpy as np
+
+from dynamo_exp_tpu.sdk import async_on_start, endpoint, service
+
+logger = logging.getLogger(__name__)
+
+
+class PatchEncoder:
+    """Patchify [H, W, 3] → project each patch to the LM hidden size."""
+
+    def __init__(self, hidden_size: int, patch: int = 16, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.patch = patch
+        self.hidden = hidden_size
+        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+        in_dim = patch * patch * 3
+        self.w_proj = jax.random.normal(
+            k1, (in_dim, hidden_size), jnp.float32
+        ) * (in_dim**-0.5)
+        self.w_mix = jax.random.normal(
+            k2, (hidden_size, hidden_size), jnp.float32
+        ) * (hidden_size**-0.5)
+
+        @jax.jit
+        def encode(img):  # [H, W, 3] float32 in [0, 1]
+            H, W, _ = img.shape
+            p = self.patch
+            patches = (
+                img[: H - H % p, : W - W % p]
+                .reshape(H // p, p, W // p, p, 3)
+                .transpose(0, 2, 1, 3, 4)
+                .reshape(-1, p * p * 3)
+            )
+            x = patches @ self.w_proj
+            return x + jnp.tanh(x) @ self.w_mix  # [n_patches, hidden]
+
+        self._encode = encode
+
+    def __call__(self, image: np.ndarray) -> np.ndarray:
+        return np.asarray(self._encode(image.astype(np.float32)))
+
+
+def decode_image(request: dict) -> np.ndarray:
+    """Accept {"pixels": [[...]] } (nested lists) or {"image_b64",
+    "shape"} (raw float32 bytes) — no PIL dependency needed."""
+    if "pixels" in request:
+        return np.asarray(request["pixels"], np.float32)
+    raw = base64.b64decode(request["image_b64"])
+    return np.frombuffer(raw, np.float32).reshape(request["shape"])
+
+
+@service(dynamo={"namespace": "multimodal"}, resources={"tpu": 1})
+class EncodeWorker:
+    hidden_size: int = 2048
+    patch: int = 16
+
+    def __init__(self):
+        self.encoder = None
+        self.encoded = 0
+
+    @async_on_start
+    async def build(self) -> None:
+        self.encoder = PatchEncoder(self.hidden_size, self.patch)
+
+    @endpoint()
+    async def encode(self, request: dict):
+        image = decode_image(request)
+        features = self.encoder(image)
+        self.encoded += 1
+        yield {
+            "image_features": features.tolist(),
+            "n_patches": int(features.shape[0]),
+        }

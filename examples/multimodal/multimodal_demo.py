@@ -1,0 +1,80 @@
+"""Multimodal demo graph: EncodeWorker → soft-token prefill.
+
+Reference parity: ``/root/reference/examples/multimodal/`` (encode
+worker feeds image features to the LLM worker, which prefixes them to
+the prompt). The LLM side here drives the model layer directly with
+``forward(token_embeds=...)``: image patch embeddings followed by the
+prompt's token embeddings, one greedy decode step.
+
+    python -m dynamo_exp_tpu.sdk.serve \
+        examples.multimodal.multimodal_demo:VisionChat --start-coordinator
+"""
+
+from __future__ import annotations
+
+import logging
+
+from dynamo_exp_tpu.sdk import async_on_start, depends, endpoint, service
+
+from .components.encode_worker import EncodeWorker
+
+logger = logging.getLogger(__name__)
+
+
+@service(dynamo={"namespace": "multimodal"}, resources={"tpu": 1})
+class VisionChat:
+    """Consumes encoded image features as a soft-token prefix."""
+
+    encoder = depends(EncodeWorker, endpoint="encode")
+
+    preset: str = "tiny"
+
+    def __init__(self):
+        self.params = None
+        self.cfg = None
+
+    @async_on_start
+    async def build(self) -> None:
+        import jax
+
+        from dynamo_exp_tpu.models import PRESETS, init_params
+
+        self.cfg = PRESETS[self.preset]
+        self.params = init_params(jax.random.PRNGKey(0), self.cfg)
+
+    @endpoint()
+    async def generate(self, request: dict):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dynamo_exp_tpu.models import init_kv_cache
+        from dynamo_exp_tpu.models.llama import forward
+
+        stream = await self.encoder.generate(
+            {"pixels": request["pixels"], "shape": request.get("shape")}
+        )
+        features = None
+        async for item in stream:
+            features = np.asarray(item["image_features"], np.float32)
+        prompt = list(request.get("token_ids", []))
+
+        # Soft-token prefix: [image patches] + [prompt embeddings].
+        embed = np.asarray(self.params["embed"], np.float32)
+        feats = features[:, : self.cfg.hidden_size]
+        x = np.concatenate([feats, embed[prompt]], axis=0)[None]
+        T = x.shape[1]
+        ps = 16
+        pmax = (T + ps - 1) // ps
+        k, v = init_kv_cache(self.cfg, num_pages=pmax + 1, page_size=ps)
+        logits, _, _ = forward(
+            self.params,
+            self.cfg,
+            jnp.zeros((1, T), jnp.int32),
+            jnp.arange(T, dtype=jnp.int32)[None],
+            jnp.arange(1, pmax + 1, dtype=jnp.int32)[None],
+            k,
+            v,
+            token_embeds=jnp.asarray(x),
+        )
+        next_token = int(jnp.argmax(logits[0, -1]))
+        yield {"n_image_tokens": int(feats.shape[0]), "next_token": next_token}

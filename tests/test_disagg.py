@@ -82,6 +82,84 @@ def test_page_codec_roundtrip_bfloat16():
         np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
 
 
+async def test_chunked_transfer_bounded_frames_and_overlap():
+    """Chunked framing: every DATA frame carries at most ``chunk_pages``
+    pages (bounded per-frame memory vs the old everything-in-one-frame
+    shape), order is preserved, and chunks become visible at the
+    receiver while the sender is still transmitting (the overlap the
+    reference gets from incremental NIXL writes)."""
+    from dynamo_exp_tpu.runtime.transports import codec as codec_mod
+
+    rs = np.random.RandomState(1)
+    pages = [
+        (
+            rs.randn(2, PS, 8).astype(np.float32),
+            rs.randn(2, PS, 8).astype(np.float32),
+        )
+        for _ in range(11)
+    ]
+    page_bytes = pages[0][0].nbytes * 2
+    chunk_pages = 3
+
+    # Observe every frame the receiver reads to enforce the size cap.
+    frame_payloads: list[int] = []
+    orig_read = codec_mod.read_message
+
+    async def spy_read(reader):
+        msg = await orig_read(reader)
+        frame_payloads.append(len(msg.payload or b""))
+        return msg
+
+    recv = KvPageReceiver()
+    await recv.start()
+    from dynamo_exp_tpu.disagg import transfer as transfer_mod
+
+    transfer_mod_read = transfer_mod.read_message
+    transfer_mod.read_message = spy_read
+    streamed: list = []
+    try:
+        fut = recv.expect("r-chunk", on_chunk=streamed.extend)
+        await send_kv_pages(
+            recv.address, "r-chunk", 9, pages, chunk_pages=chunk_pages,
+            window=2,
+        )
+        tok, got = await asyncio.wait_for(fut, 10)
+    finally:
+        transfer_mod.read_message = transfer_mod_read
+        await recv.close()
+    assert tok == 9
+    # Streaming consumer: pages travel only through the callback (the
+    # receiver never accumulates), future resolves empty.
+    assert got == []
+    assert len(streamed) == 11
+    for (k1, v1), (k2, v2) in zip(pages, streamed):
+        np.testing.assert_array_equal(k1, k2)
+        np.testing.assert_array_equal(v1, v2)
+    # Size cap: no frame payload exceeds chunk_pages pages.
+    assert max(frame_payloads) <= chunk_pages * page_bytes
+
+
+async def test_chunked_transfer_without_callback_accumulates():
+    """No on_chunk: the future carries everything (legacy consumers)."""
+    rs = np.random.RandomState(2)
+    pages = [
+        (rs.randn(1, PS, 4).astype(np.float32),
+         rs.randn(1, PS, 4).astype(np.float32))
+        for _ in range(5)
+    ]
+    recv = KvPageReceiver()
+    await recv.start()
+    try:
+        fut = recv.expect("r-acc")
+        await send_kv_pages(recv.address, "r-acc", 3, pages, chunk_pages=2)
+        tok, got = await asyncio.wait_for(fut, 10)
+    finally:
+        await recv.close()
+    assert tok == 3 and len(got) == 5
+    for (k1, _), (k2, _) in zip(pages, got):
+        np.testing.assert_array_equal(k1, k2)
+
+
 async def test_receiver_delivery_and_error():
     recv = KvPageReceiver()
     await recv.start()

@@ -1,0 +1,184 @@
+"""llmctl CLI, standalone KV-router service, and request template tests.
+
+Reference capability anchors: ``launch/llmctl/src/main.rs:101-454``,
+``components/router/src/main.rs:33-60``, ``lib/llm/src/request_template.rs``.
+"""
+
+import asyncio
+import json
+
+from dynamo_exp_tpu import llmctl
+from dynamo_exp_tpu.local_model import MODELS_PREFIX, ModelEntry
+from dynamo_exp_tpu.protocols.request_template import RequestTemplate
+from dynamo_exp_tpu.runtime.component import DistributedRuntime
+from dynamo_exp_tpu.runtime.config import RuntimeConfig
+from dynamo_exp_tpu.runtime.transports.coordinator import CoordinatorServer
+
+
+async def _with_coordinator():
+    server = CoordinatorServer()
+    await server.start()
+    drt = DistributedRuntime(
+        config=RuntimeConfig(coordinator_endpoint=server.address)
+    )
+    return server, drt
+
+
+# ------------------------------------------------------------------ llmctl
+async def test_llmctl_add_list_remove(capsys):
+    server, drt = await _with_coordinator()
+    try:
+        parser = llmctl.build_parser()
+        add = parser.parse_args(
+            ["--coordinator", server.address, "http", "add",
+             "chat-model", "foo/v1", "TpuWorker.generate"]
+        )
+        assert await llmctl.add_model(drt, add) == 0
+
+        entries = await drt.discovery.kv_get_prefix(MODELS_PREFIX)
+        assert len(entries) == 1
+        e = ModelEntry.from_bytes(next(iter(entries.values())))
+        assert e.name == "foo/v1"
+        assert e.endpoint == "dyn://dynamo.TpuWorker.generate"
+        assert e.model_type == "chat"
+
+        lst = parser.parse_args(
+            ["--coordinator", server.address, "http", "list", "--json"]
+        )
+        assert await llmctl.list_models(drt, lst) == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        rows = json.loads(out)
+        assert rows == [
+            {"name": "foo/v1", "type": "chat",
+             "endpoint": "dyn://dynamo.TpuWorker.generate", "owner": "llmctl"}
+        ]
+
+        rm = parser.parse_args(
+            ["--coordinator", server.address, "http", "remove",
+             "model", "foo/v1"]
+        )
+        assert await llmctl.remove_model(drt, rm) == 0
+        assert not await drt.discovery.kv_get_prefix(MODELS_PREFIX)
+        # Removing again reports failure.
+        assert await llmctl.remove_model(drt, rm) == 1
+    finally:
+        await drt.close()
+        await server.close()
+
+
+def test_llmctl_endpoint_qualification():
+    assert llmctl._qualify("a.b", "ns") == "dyn://ns.a.b"
+    assert llmctl._qualify("x.a.b", "ns") == "dyn://x.a.b"
+    assert llmctl._qualify("dyn://x.a.b", "ns") == "dyn://x.a.b"
+
+
+# ----------------------------------------------------------- router service
+async def test_standalone_router_service_routes_by_overlap():
+    """The router service watches a worker component's KV events and
+    answers scheduling queries over the request plane."""
+    from dynamo_exp_tpu.components.router import RouterService
+    from dynamo_exp_tpu.kv_router.protocols import (
+        KvCacheEventData,
+        RouterEvent,
+        kv_events_subject,
+    )
+    from dynamo_exp_tpu.tokens import compute_block_hashes_for_seq, chain_hash
+
+    server, drt = await _with_coordinator()
+    svc = None
+    worker = None
+    try:
+        # A live worker with load stats: the scheduler only considers
+        # workers whose metrics it can scrape.
+        async def noop(request, context=None):
+            yield {"data": {}}
+
+        stats = {
+            "request_active_slots": 1, "request_total_slots": 8,
+            "kv_active_blocks": 4, "kv_total_blocks": 64,
+            "num_requests_waiting": 0, "gpu_cache_usage_perc": 0.05,
+            "gpu_prefix_cache_hit_rate": 0.0,
+        }
+        workers_comp = drt.namespace("ns").component("Workers")
+        worker = await workers_comp.endpoint("generate").serve_endpoint(
+            noop, stats_handler=lambda: stats
+        )
+        wid = worker.instance_id
+
+        svc = RouterService(drt, "ns", "Workers", block_size=4)
+        await svc.start()
+
+        # The worker announces pages for the prefix of a known prompt.
+        prompt = list(range(16))
+        hashes = compute_block_hashes_for_seq(prompt, 4)
+        await drt.event_plane.publish(
+            kv_events_subject(workers_comp.path),
+            RouterEvent(
+                worker_id=wid,
+                data=KvCacheEventData(
+                    kind="stored", block_hashes=hashes[:2], parent_hash=None
+                ),
+            ).to_dict(),
+        )
+        await asyncio.sleep(0.3)  # indexer consume + metrics scrape
+
+        ep = drt.namespace("ns").component("kv_aware_router").endpoint(
+            "generate"
+        )
+        client = await ep.client()
+        await client.wait_for_instances(1, timeout=10)
+        stream = await client.generate_to(
+            client.instances[0], {"token_ids": prompt}
+        )
+        replies = [a.data async for a in stream if a.data is not None]
+        assert replies and replies[0]["worker_id"] == wid
+        assert replies[0]["overlap_blocks"] == 2
+    finally:
+        if svc is not None:
+            await svc.stop()
+        if worker is not None:
+            await worker.close()
+        await drt.close()
+        await server.close()
+
+
+# --------------------------------------------------------- request template
+def test_request_template_applies_defaults(tmp_path):
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps(
+        {"model": "foo", "temperature": 0.6, "max_completion_tokens": 42}
+    ))
+    t = RequestTemplate.load(str(p))
+    req = t.apply({"messages": []})
+    assert req["model"] == "foo"
+    assert req["temperature"] == 0.6
+    assert req["max_completion_tokens"] == 42
+    # Explicit values win.
+    req = t.apply({"model": "bar", "temperature": 0.0, "max_tokens": 5})
+    assert req["model"] == "bar"
+    assert req["temperature"] == 0.0
+    assert "max_completion_tokens" not in req
+
+
+async def test_request_template_through_http_service():
+    from aiohttp import ClientSession
+
+    from dynamo_exp_tpu.engines.echo import EchoEngineFull
+    from dynamo_exp_tpu.http import HttpService
+
+    t = RequestTemplate(model="echo", max_completion_tokens=3)
+    svc = HttpService(host="127.0.0.1", port=0, request_template=t)
+    svc.manager.add_completion_model("echo", EchoEngineFull())
+    port = await svc.start()
+    try:
+        async with ClientSession() as sess:
+            # No model in the body: the template routes it.
+            async with sess.post(
+                f"http://127.0.0.1:{port}/v1/completions",
+                json={"prompt": "a b c d e"},
+            ) as r:
+                assert r.status == 200, await r.text()
+                data = await r.json()
+        assert data["model"] == "echo"
+    finally:
+        await svc.stop()

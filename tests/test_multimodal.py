@@ -1,0 +1,79 @@
+"""Multimodal seam tests: soft-token forward + the encode worker graph.
+
+Reference capability anchor: ``examples/multimodal/components/
+encode_worker.py:21-60`` (separate encode worker streaming image
+features into the LLM's input sequence).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_exp_tpu.models import TINY, forward, init_kv_cache, init_params
+
+
+def test_forward_token_embeds_matches_id_lookup():
+    """Soft tokens that equal the embedding rows must reproduce the
+    id-based forward exactly — pins the token_embeds seam."""
+    cfg = dataclasses.replace(TINY, dtype="float32")
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    toks = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6]], jnp.int32)
+    pos = jnp.arange(8, dtype=jnp.int32)[None]
+    table = jnp.asarray([[1]], jnp.int32)
+
+    def run(**kw):
+        k, v = init_kv_cache(cfg, num_pages=4, page_size=8, dtype=jnp.float32)
+        out, _, _ = forward(params, cfg, toks, pos, table, k, v, **kw)
+        return np.asarray(out)
+
+    embeds = jnp.take(params["embed"], toks, axis=0)
+    np.testing.assert_allclose(
+        run(token_embeds=embeds), run(), atol=1e-6
+    )
+
+
+def test_patch_encoder_shapes():
+    from examples.multimodal.components.encode_worker import PatchEncoder
+
+    enc = PatchEncoder(hidden_size=64, patch=8)
+    img = np.random.RandomState(0).rand(32, 24, 3)
+    out = enc(img)
+    assert out.shape == (4 * 3, 64)  # 32/8 x 24/8 patches
+
+
+async def test_encode_worker_to_vision_chat_flow():
+    """The demo graph end-to-end in-process: encode → soft-token prefill
+    → a sampled token."""
+    from examples.multimodal.components.encode_worker import EncodeWorker
+    from examples.multimodal.multimodal_demo import VisionChat
+
+    enc = EncodeWorker()
+    enc.hidden_size = 64
+    enc.patch = 8
+    await enc.build()
+
+    chat = VisionChat()
+    await chat.build()
+
+    # Wire the dependency by hand (no supervisor in this test).
+    class _Dep:
+        async def generate(self, request):
+            async def gen():
+                async for item in enc.encode(request):
+                    yield item
+
+            return gen()
+
+    VisionChat.encoder._client = _Dep()
+    img = np.random.RandomState(1).rand(16, 16, 3)
+    results = []
+    async for item in chat.generate(
+        {"pixels": img.tolist(), "token_ids": [5, 7, 9]}
+    ):
+        results.append(item)
+    VisionChat.encoder._client = None
+    assert results
+    assert results[0]["n_image_tokens"] == 4  # 16/8 x 16/8
+    assert 0 <= results[0]["next_token"] < TINY.vocab_size

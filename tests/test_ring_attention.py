@@ -107,6 +107,40 @@ def test_ring_prefill_matches_paged_forward():
     )
 
 
+def test_ring_prefill_composes_with_tp():
+    """sp=4 × tp=2: tp-sharded projections + ring over the sequence must
+    reproduce the replicated single-device forward (the round-4 fix for
+    the 'params replicated inside the sp path' limitation)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, dtype="float32")  # exact split-K sums
+    ps = 8
+    T = 64
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rs = np.random.RandomState(4)
+    tokens = jnp.asarray(rs.randint(3, cfg.vocab_size, size=(1, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+
+    k0, v0 = init_kv_cache(cfg, num_pages=T // ps, page_size=ps)
+    table = jnp.arange(T // ps, dtype=jnp.int32)[None, :]
+    want_logits, want_k, _ = forward(params, cfg, tokens, positions, table, k0, v0)
+
+    mesh = build_mesh(sp=4, tp=2)
+    got_logits, got_k, got_v = forward_ring_prefill(
+        params, cfg, tokens, positions, mesh
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), atol=2e-4, rtol=1e-4
+    )
+    # K/V: ring [L, B, T, Hkv, D] vs fused-lane pool [L, P, ps, Hkv*D].
+    L, Pn, _, fused = np.asarray(want_k).shape
+    np.testing.assert_allclose(
+        np.asarray(got_k).reshape(L, Pn, ps, fused),
+        np.asarray(want_k),
+        atol=1e-5,
+    )
+
+
 def test_ring_prefill_rejects_indivisible_seq():
     cfg = TINY
     mesh = ring_mesh()
