@@ -49,7 +49,11 @@ async def add_model(drt, args) -> int:
         await drt.object_store.put(
             MDC_BUCKET, entry.mdc_key, mdc.to_json().encode()
         )
-    key = f"{MODELS_PREFIX}{_slug(args.model_name)}/llmctl"
+    # Key carries the model type so chat + completion registrations of
+    # one name coexist (and remove stays type-scoped).
+    key = (
+        f"{MODELS_PREFIX}{_slug(args.model_name)}/llmctl-{entry.model_type}"
+    )
     await drt.discovery.kv_put(key, entry.to_bytes())
     print(f"added {entry.model_type} model {entry.name} -> {entry.endpoint}")
     return 0
@@ -83,14 +87,28 @@ async def list_models(drt, args) -> int:
 
 
 async def remove_model(drt, args) -> int:
+    """Remove registrations of the given type only — a model registered
+    as both chat and completion under one name keeps the other entry
+    (type-scoped like the reference llmctl,
+    ``/root/reference/launch/llmctl/src/main.rs:101-454``)."""
+    want = _TYPES.get(args.model_type or "model", "both")
     prefix = f"{MODELS_PREFIX}{_slug(args.model_name)}/"
     entries = await drt.discovery.kv_get_prefix(prefix)
-    if not entries:
-        print(f"no registration for {args.model_name}", file=sys.stderr)
-        return 1
-    for key in entries:
+    removed = 0
+    for key, raw in entries.items():
+        try:
+            e = ModelEntry.from_bytes(raw)
+        except (ValueError, TypeError, KeyError):
+            continue
+        if want != "both" and e.model_type not in (want, "both"):
+            continue
         await drt.discovery.kv_delete(key)
-    print(f"removed {len(entries)} registration(s) for {args.model_name}")
+        removed += 1
+    if not removed:
+        print(f"no {args.model_type} registration for {args.model_name}",
+              file=sys.stderr)
+        return 1
+    print(f"removed {removed} registration(s) for {args.model_name}")
     return 0
 
 

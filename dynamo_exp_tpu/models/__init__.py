@@ -1,4 +1,16 @@
-from .config import LLAMA_1B, LLAMA_3B, LLAMA_8B, PRESETS, TINY, ModelConfig
+from .config import (
+    LLAMA_1B,
+    LLAMA_3B,
+    LLAMA_8B,
+    MISTRAL_7B,
+    MIXTRAL_8X7B,
+    PRESETS,
+    QWEN2_7B,
+    TINY,
+    TINY_MOE,
+    TINY_QWEN2,
+    ModelConfig,
+)
 from .llama import (
     forward,
     init_kv_cache,
@@ -10,9 +22,14 @@ from .llama import (
 __all__ = [
     "ModelConfig",
     "TINY",
+    "TINY_QWEN2",
+    "TINY_MOE",
     "LLAMA_1B",
     "LLAMA_3B",
     "LLAMA_8B",
+    "QWEN2_7B",
+    "MISTRAL_7B",
+    "MIXTRAL_8X7B",
     "PRESETS",
     "forward",
     "init_params",

@@ -16,8 +16,12 @@ from dataclasses import dataclass, field
 
 @dataclass(frozen=True)
 class ModelConfig:
-    """Llama-family architecture hyperparameters (covers Llama 2/3,
-    DeepSeek-R1-Distill-Llama, TinyLlama, Qwen2-without-bias subset)."""
+    """Decoder-transformer architecture hyperparameters. One config class
+    covers the supported families — llama (Llama 2/3,
+    DeepSeek-R1-Distill-Llama, TinyLlama), mistral (sliding-window
+    attention), qwen2 (QKV bias), mixtral/qwen2-style sparse MoE — with
+    family differences expressed as fields, not subclasses, so the single
+    scan-over-layers forward stays one compiled program per family."""
 
     vocab_size: int = 32000
     hidden_size: int = 4096
@@ -34,8 +38,19 @@ class ModelConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     attention_bias: bool = False
+    # Mistral: keys older than (q_pos - sliding_window + 1) are masked.
+    # None = full causal attention.
+    sliding_window: int | None = None
+    # Sparse MoE (mixtral): 0 experts = dense FFN.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    norm_topk_prob: bool = True
     dtype: str = "bfloat16"
     model_type: str = "llama"
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     @property
     def head_dim_(self) -> int:
@@ -47,7 +62,11 @@ class ModelConfig:
 
     @classmethod
     def from_hf_config(cls, cfg: dict) -> "ModelConfig":
-        """Build from a HuggingFace ``config.json`` dict."""
+        """Build from a HuggingFace ``config.json`` dict. Family quirks:
+        qwen2 always carries QKV bias (its HF config has no
+        ``attention_bias`` key); mistral/mixtral carry ``sliding_window``;
+        mixtral's experts are ``num_local_experts``."""
+        model_type = cfg.get("model_type", "llama")
         return cls(
             vocab_size=cfg.get("vocab_size", 32000),
             hidden_size=cfg.get("hidden_size", 4096),
@@ -63,9 +82,17 @@ class ModelConfig:
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
-            attention_bias=cfg.get("attention_bias", False),
+            attention_bias=cfg.get(
+                "attention_bias", model_type in ("qwen2", "qwen2_moe")
+            ),
+            sliding_window=cfg.get("sliding_window"),
+            num_experts=cfg.get(
+                "num_local_experts", cfg.get("num_experts", 0)
+            ) or 0,
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            norm_topk_prob=cfg.get("norm_topk_prob", True),
             dtype=cfg.get("torch_dtype", "bfloat16"),
-            model_type=cfg.get("model_type", "llama"),
+            model_type=model_type,
         )
 
     @classmethod
@@ -123,4 +150,80 @@ LLAMA_8B = ModelConfig(  # Llama-3.1-8B / DeepSeek-R1-Distill-Llama-8B shape
     max_position_embeddings=8192,
 )
 
-PRESETS = {"tiny": TINY, "llama-1b": LLAMA_1B, "llama-3b": LLAMA_3B, "llama-8b": LLAMA_8B}
+TINY_QWEN2 = ModelConfig(  # qwen2 family shape: QKV bias, tied embeddings
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    max_position_embeddings=512,
+    attention_bias=True,
+    tie_word_embeddings=True,
+    model_type="qwen2",
+)
+
+TINY_MOE = ModelConfig(  # mixtral family shape: 4 experts, top-2 routing
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=96,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    max_position_embeddings=512,
+    num_experts=4,
+    num_experts_per_tok=2,
+    model_type="mixtral",
+)
+
+QWEN2_7B = ModelConfig(  # Qwen2-7B-Instruct shape
+    vocab_size=152064,
+    hidden_size=3584,
+    intermediate_size=18944,
+    num_layers=28,
+    num_heads=28,
+    num_kv_heads=4,
+    rope_theta=1000000.0,
+    max_position_embeddings=32768,
+    attention_bias=True,
+    rms_norm_eps=1e-6,
+    model_type="qwen2",
+)
+
+MISTRAL_7B = ModelConfig(  # Mistral-7B-v0.1 shape (4k sliding window)
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    sliding_window=4096,
+    max_position_embeddings=32768,
+    model_type="mistral",
+)
+
+MIXTRAL_8X7B = ModelConfig(  # Mixtral-8x7B shape
+    vocab_size=32000,
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    rope_theta=1000000.0,
+    max_position_embeddings=32768,
+    num_experts=8,
+    num_experts_per_tok=2,
+    model_type="mixtral",
+)
+
+PRESETS = {
+    "tiny": TINY,
+    "tiny-qwen2": TINY_QWEN2,
+    "tiny-moe": TINY_MOE,
+    "llama-1b": LLAMA_1B,
+    "llama-3b": LLAMA_3B,
+    "llama-8b": LLAMA_8B,
+    "qwen2-7b": QWEN2_7B,
+    "mistral-7b": MISTRAL_7B,
+    "mixtral-8x7b": MIXTRAL_8X7B,
+}

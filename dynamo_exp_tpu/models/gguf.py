@@ -102,8 +102,12 @@ class GGUFFile:
 
     @classmethod
     def parse(cls, path: str) -> "GGUFFile":
+        # mmap, not read(): an 8B Q8_0 GGUF is ~8.5 GB — pages fault in
+        # on demand and stay evictable instead of pinning host RSS.
+        import mmap
+
         with open(path, "rb") as f:
-            buf = f.read()
+            buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
         r = _Reader(buf)
         if r.take(4) != MAGIC:
             raise ValueError(f"{path} is not a GGUF file")
@@ -175,6 +179,9 @@ def config_from_gguf(g: GGUFFile):
     if arch not in ("llama", "qwen2"):
         raise ValueError(f"unsupported GGUF architecture {arch!r}")
     a = arch
+    # qwen2 GGUFs carry QKV bias tensors; detect from the checkpoint so
+    # the forward actually applies them.
+    has_bias = "blk.0.attn_q.bias" in g.tensors
     vocab = md.get(f"{a}.vocab_size")
     if vocab is None:
         tokens = md.get("tokenizer.ggml.tokens")
@@ -197,6 +204,7 @@ def config_from_gguf(g: GGUFFile):
         ),
         max_position_embeddings=int(md.get(f"{a}.context_length", 4096)),
         tie_word_embeddings="output.weight" not in g.tensors,
+        attention_bias=has_bias,
         model_type=a,
     )
 
@@ -231,13 +239,20 @@ def load_params_from_gguf(path: str, cfg=None):
         # GGUF stores the torch [out, in] weight; we use x @ W.
         return g.tensor(name).T
 
-    def qk(name: str, heads: int) -> np.ndarray:
-        return _unpermute_rope(g.tensor(name), heads).T
+    # llama.cpp's converter permutes q/k weights ONLY for the llama
+    # architecture (qwen2 uses NEOX-style rope and stores them as-is);
+    # unpermuting unconditionally would scramble qwen2 head halves.
+    permuted = cfg.model_type == "llama"
 
-    layers: dict[str, list] = {k: [] for k in (
-        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-        "w_gate", "w_up", "w_down",
-    )}
+    def qk(name: str, heads: int) -> np.ndarray:
+        w = g.tensor(name)
+        return (_unpermute_rope(w, heads) if permuted else w).T
+
+    keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+            "w_gate", "w_up", "w_down"]
+    if cfg.attention_bias:
+        keys += ["bq", "bk", "bv"]
+    layers: dict[str, list] = {k: [] for k in keys}
     for i in range(cfg.num_layers):
         p = f"blk.{i}."
         layers["attn_norm"].append(g.tensor(p + "attn_norm.weight"))
@@ -246,6 +261,10 @@ def load_params_from_gguf(path: str, cfg=None):
         layers["wv"].append(linear(p + "attn_v.weight"))
         layers["wo"].append(linear(p + "attn_output.weight"))
         layers["mlp_norm"].append(g.tensor(p + "ffn_norm.weight"))
+        if cfg.attention_bias:
+            layers["bq"].append(g.tensor(p + "attn_q.bias"))
+            layers["bk"].append(g.tensor(p + "attn_k.bias"))
+            layers["bv"].append(g.tensor(p + "attn_v.bias"))
         layers["w_gate"].append(linear(p + "ffn_gate.weight"))
         layers["w_up"].append(linear(p + "ffn_up.weight"))
         layers["w_down"].append(linear(p + "ffn_down.weight"))

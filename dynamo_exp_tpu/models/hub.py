@@ -54,12 +54,21 @@ def resolve_model_path(name_or_path: str) -> str:
     from huggingface_hub import snapshot_download
     from huggingface_hub.errors import LocalEntryNotFoundError
 
+    # huggingface_hub freezes HF_HOME/HF_HUB_CACHE into module constants
+    # at first import; read the env at call time instead so processes
+    # that configure the cache after importing transformers (and tests
+    # that monkeypatch it) still resolve against the intended directory.
+    cache_dir = os.environ.get("HF_HUB_CACHE")
+    if not cache_dir and os.environ.get("HF_HOME"):
+        cache_dir = os.path.join(os.environ["HF_HOME"], "hub")
+
     try:
         # Cache-first: never touch the network for a model that is
         # already resident (works fully offline).
         return snapshot_download(
             name_or_path,
             local_files_only=True,
+            cache_dir=cache_dir,
             ignore_patterns=IGNORE_PATTERNS,
         )
     except LocalEntryNotFoundError:
@@ -67,7 +76,7 @@ def resolve_model_path(name_or_path: str) -> str:
     logger.info("downloading %s from the HuggingFace hub", name_or_path)
     try:
         return snapshot_download(
-            name_or_path, ignore_patterns=IGNORE_PATTERNS
+            name_or_path, cache_dir=cache_dir, ignore_patterns=IGNORE_PATTERNS
         )
     except Exception as e:
         raise RuntimeError(

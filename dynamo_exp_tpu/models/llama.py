@@ -55,24 +55,36 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         cfg.intermediate_size,
         cfg.vocab_size,
     )
-    ks = jax.random.split(rng, 10)
+    ks = jax.random.split(rng, 13)
 
     def init(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(dt)
 
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), dt),
+        "wq": init(ks[1], (L, D, H * hd), D),
+        "wk": init(ks[2], (L, D, Hkv * hd), D),
+        "wv": init(ks[3], (L, D, Hkv * hd), D),
+        "wo": init(ks[4], (L, H * hd, D), H * hd),
+        "mlp_norm": jnp.ones((L, D), dt),
+    }
+    if cfg.attention_bias:  # qwen2: bias on q/k/v, none on o
+        layers["bq"] = init(ks[9], (L, H * hd), H * hd)
+        layers["bk"] = init(ks[10], (L, Hkv * hd), Hkv * hd)
+        layers["bv"] = init(ks[11], (L, Hkv * hd), Hkv * hd)
+    if cfg.is_moe:
+        E = cfg.num_experts
+        layers["router"] = init(ks[12], (L, D, E), D)
+        layers["w_gate"] = init(ks[5], (L, E, D, I), D)
+        layers["w_up"] = init(ks[6], (L, E, D, I), D)
+        layers["w_down"] = init(ks[7], (L, E, I, D), I)
+    else:
+        layers["w_gate"] = init(ks[5], (L, D, I), D)
+        layers["w_up"] = init(ks[6], (L, D, I), D)
+        layers["w_down"] = init(ks[7], (L, I, D), I)
     params: Params = {
         "embed": init(ks[0], (V, D), D),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), dt),
-            "wq": init(ks[1], (L, D, H * hd), D),
-            "wk": init(ks[2], (L, D, Hkv * hd), D),
-            "wv": init(ks[3], (L, D, Hkv * hd), D),
-            "wo": init(ks[4], (L, H * hd, D), H * hd),
-            "mlp_norm": jnp.ones((L, D), dt),
-            "w_gate": init(ks[5], (L, D, I), D),
-            "w_up": init(ks[6], (L, D, I), D),
-            "w_down": init(ks[7], (L, I, D), I),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), dt),
     }
     if not cfg.tie_word_embeddings:
@@ -84,19 +96,33 @@ def param_shardings(cfg: ModelConfig, tp_axis: str = "tp") -> Params:
     """PartitionSpec pytree matching ``init_params``: megatron-style TP —
     QKV/gate/up column-sharded over heads/ffn, O/down row-sharded, embed
     and lm_head vocab-sharded."""
+    layers: Params = {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, tp_axis),
+        "wk": P(None, None, tp_axis),
+        "wv": P(None, None, tp_axis),
+        "wo": P(None, tp_axis, None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = P(None, tp_axis)
+        layers["bk"] = P(None, tp_axis)
+        layers["bv"] = P(None, tp_axis)
+    if cfg.is_moe:
+        # Replicated router; every expert's FFN tp-sharded on the ffn dim
+        # (same layout as the dense path, so MoE composes with the
+        # existing GSPMD collectives regardless of routing skew).
+        layers["router"] = P(None, None, None)
+        layers["w_gate"] = P(None, None, None, tp_axis)
+        layers["w_up"] = P(None, None, None, tp_axis)
+        layers["w_down"] = P(None, None, tp_axis, None)
+    else:
+        layers["w_gate"] = P(None, None, tp_axis)
+        layers["w_up"] = P(None, None, tp_axis)
+        layers["w_down"] = P(None, tp_axis, None)
     specs: Params = {
         "embed": P(tp_axis, None),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, tp_axis),
-            "wk": P(None, None, tp_axis),
-            "wv": P(None, None, tp_axis),
-            "wo": P(None, tp_axis, None),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, None, tp_axis),
-            "w_up": P(None, None, tp_axis),
-            "w_down": P(None, tp_axis, None),
-        },
+        "layers": layers,
         "final_norm": P(None),
     }
     if not cfg.tie_word_embeddings:
@@ -151,21 +177,41 @@ def _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend, reduce=None):
     holds H/tp heads. ``reduce`` (e.g. ``psum`` over the tp axis) is
     applied to the two row-sharded matmul outputs before the residual
     adds; None means the weights are unsharded.
+
+    Family variations live in the param pytree: ``bq/bk/bv`` present =
+    QKV bias (qwen2); ``router`` present = sparse-MoE FFN (mixtral).
     """
     B, T = x.shape[:2]
     hd = cfg.head_dim_
     red = reduce if reduce is not None else (lambda y: y)
     h = rms_norm(x, lp["attn_norm"], eps)
-    q = (h @ lp["wq"]).reshape(B, T, lp["wq"].shape[-1] // hd, hd)
-    k = (h @ lp["wk"]).reshape(B, T, lp["wk"].shape[-1] // hd, hd)
-    v = (h @ lp["wv"]).reshape(B, T, lp["wv"].shape[-1] // hd, hd)
+    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    if "bq" in lp:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, T, lp["wq"].shape[-1] // hd, hd)
+    k = k.reshape(B, T, lp["wk"].shape[-1] // hd, hd)
+    v = v.reshape(B, T, lp["wv"].shape[-1] // hd, hd)
     q = apply_rope(q, rope_pos, inv_freq)
     k = apply_rope(k, rope_pos, inv_freq)
     attn, kv_extra = attend(q, k, v)
     x = x + red(attn.reshape(B, T, -1) @ lp["wo"])
     h = rms_norm(x, lp["mlp_norm"], eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-    x = x + red((gate * (h @ lp["w_up"])) @ lp["w_down"])
+    if "router" in lp:
+        from ..ops.moe import moe_ffn
+
+        y = moe_ffn(
+            h.reshape(B * T, -1),
+            lp["router"],
+            lp["w_gate"],
+            lp["w_up"],
+            lp["w_down"],
+            cfg.num_experts_per_tok,
+            cfg.norm_topk_prob,
+        ).reshape(B, T, -1)
+        x = x + red(y)
+    else:
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        x = x + red((gate * (h @ lp["w_up"])) @ lp["w_down"])
     return x, kv_extra
 
 
@@ -238,7 +284,9 @@ def forward(
     )  # [B, T, D]
     rope_pos = jnp.maximum(positions, 0)
 
-    use_pallas = attn_impl == "pallas" and T == 1
+    # Pallas decode reads full ragged context; sliding-window models
+    # stay on the XLA path where the window mask lives.
+    use_pallas = attn_impl == "pallas" and T == 1 and cfg.sliding_window is None
     if use_pallas:
         lengths = jnp.maximum(positions[:, 0] + 1, 0)
     attn_table = (
@@ -270,7 +318,13 @@ def forward(
                     interpret,
                 )[:, None]
                 return attn, (kp, vp)
-            return paged_attention(q, kp, vp, attn_table, positions), (kp, vp)
+            return (
+                paged_attention(
+                    q, kp, vp, attn_table, positions,
+                    window=cfg.sliding_window,
+                ),
+                (kp, vp),
+            )
 
         return _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend)
 
@@ -363,6 +417,11 @@ def forward_ring_prefill(
     sp = mesh.shape[sp_axis]
     tp = mesh.shape.get(tp_axis, 1)
     B, T = tokens.shape
+    if cfg.sliding_window is not None:
+        raise ValueError(
+            "ring prefill does not implement sliding-window attention; "
+            "use the paged prefill path for mistral-family models"
+        )
     if T % sp:
         raise ValueError(f"seq len {T} not divisible by sp={sp}")
     if cfg.num_kv_heads % tp:

@@ -37,10 +37,23 @@ def _open_safetensors(path: str):
     return handles, index
 
 
+SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral")
+
+
 def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, ModelConfig]:
-    """Load a HF Llama checkpoint directory into the stacked param pytree."""
+    """Load a HF checkpoint directory (llama/mistral/qwen2/mixtral
+    families) into the stacked param pytree."""
     if cfg is None:
         cfg = ModelConfig.from_pretrained(path)
+    if cfg.model_type not in SUPPORTED_MODEL_TYPES:
+        # Fail loudly: e.g. qwen2_moe parses to an MoE config but uses
+        # different tensor names (mlp.experts.N.gate_proj + shared
+        # expert) — loading it with mixtral names would KeyError deep in
+        # the loop with no hint the arch is unsupported.
+        raise ValueError(
+            f"unsupported model_type {cfg.model_type!r}; "
+            f"supported: {SUPPORTED_MODEL_TYPES}"
+        )
     handles, index = _open_safetensors(path)
     dt = _dtype(cfg)
 
@@ -57,10 +70,13 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
 
     pre = "model."
     L = cfg.num_layers
-    layers: dict[str, list] = {k: [] for k in (
-        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
-        "w_gate", "w_up", "w_down",
-    )}
+    keys = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+            "w_gate", "w_up", "w_down"]
+    if cfg.attention_bias:
+        keys += ["bq", "bk", "bv"]
+    if cfg.is_moe:
+        keys.append("router")
+    layers: dict[str, list] = {k: [] for k in keys}
     for i in range(L):
         p = f"{pre}layers.{i}."
         layers["attn_norm"].append(get(p + "input_layernorm.weight"))
@@ -69,9 +85,31 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
         layers["wv"].append(linear(p + "self_attn.v_proj.weight"))
         layers["wo"].append(linear(p + "self_attn.o_proj.weight"))
         layers["mlp_norm"].append(get(p + "post_attention_layernorm.weight"))
-        layers["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
-        layers["w_up"].append(linear(p + "mlp.up_proj.weight"))
-        layers["w_down"].append(linear(p + "mlp.down_proj.weight"))
+        if cfg.attention_bias:  # qwen2: bias on q/k/v only
+            layers["bq"].append(get(p + "self_attn.q_proj.bias"))
+            layers["bk"].append(get(p + "self_attn.k_proj.bias"))
+            layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+        if cfg.is_moe:
+            # Mixtral: w1=gate, w3=up, w2=down, per expert; stack to
+            # [E, D, I] / [E, I, D] for the grouped ragged_dot matmuls.
+            m = p + "block_sparse_moe."
+            layers["router"].append(linear(m + "gate.weight"))
+            layers["w_gate"].append(np.stack([
+                linear(f"{m}experts.{e}.w1.weight")
+                for e in range(cfg.num_experts)
+            ]))
+            layers["w_up"].append(np.stack([
+                linear(f"{m}experts.{e}.w3.weight")
+                for e in range(cfg.num_experts)
+            ]))
+            layers["w_down"].append(np.stack([
+                linear(f"{m}experts.{e}.w2.weight")
+                for e in range(cfg.num_experts)
+            ]))
+        else:
+            layers["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
+            layers["w_up"].append(linear(p + "mlp.up_proj.weight"))
+            layers["w_down"].append(linear(p + "mlp.down_proj.weight"))
 
     params: Params = {
         "embed": jnp.asarray(get(pre + "embed_tokens.weight"), dt),

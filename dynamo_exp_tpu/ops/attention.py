@@ -64,11 +64,14 @@ def paged_attention(
     page_table: jnp.ndarray,  # [B, Pmax] int32
     q_positions: jnp.ndarray,  # [B, T] int32 global position of each query
     sm_scale: float | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Causal attention of queries against their sequences' pages.
 
     Returns [B, T, H, D]. Positions beyond a query's own position are
-    masked, so garbage in not-yet-written slots never leaks.
+    masked, so garbage in not-yet-written slots never leaks. ``window``
+    (mistral sliding-window attention) additionally masks keys older
+    than ``q_pos - window + 1``.
     """
     B, T, H, D = q.shape
     P, ps, _ = k_cache.shape
@@ -92,7 +95,10 @@ def paged_attention(
     )  # [B,Hkv,qpk,T,S] f32
 
     kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, None, None, :]
-    mask = kv_pos <= q_positions[:, None, None, :, None]  # causal by position
+    qp = q_positions[:, None, None, :, None]
+    mask = kv_pos <= qp  # causal by position
+    if window is not None:
+        mask &= kv_pos > qp - window
     scores = jnp.where(mask, scores, -1e30)
 
     probs = jax.nn.softmax(scores, axis=-1)

@@ -1,0 +1,107 @@
+"""Mixture-of-experts FFN: exact (dropless) top-k routing on TPU.
+
+The reference delegates MoE models (Mixtral, DeepSeek) to the engines it
+wraps (SURVEY.md §2.10 "Expert parallel / MoE: delegated to engines",
+vLLM patch DeepSeek MLA hooks). Here the TPU engine owns the model, so
+MoE is a first-class op.
+
+TPU-first design:
+
+- **Sorted dispatch + ``jax.lax.ragged_dot``**: tokens are replicated
+  k ways, sorted by expert id, and each expert's contiguous group runs
+  through a grouped matmul (MegaBlocks-style, but using XLA's native
+  ragged_dot so Mosaic picks the tiling). Exact — no capacity factor,
+  no dropped tokens, unlike the classic dispatch-einsum formulation.
+- **float32 router**: routing logits/softmax in float32; a bf16 router
+  flips top-k selections near ties and decodes diverge run-to-run.
+- **Sharding**: expert weights carry ``P(None, None, tp)`` specs —
+  every expert's FFN is tensor-parallel over the same ``tp`` axis as
+  the dense path, so MoE composes with the existing GSPMD layout and
+  XLA inserts the psum after ``w_down``. (Expert parallelism — experts
+  sharded over their own mesh axis — is a layout change on the same
+  weights; for inference the tp-within-expert layout keeps every chip
+  busy regardless of routing skew.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_router(
+    x: jnp.ndarray,  # [N, D]
+    router_w: jnp.ndarray,  # [D, E]
+    num_experts_per_tok: int,
+    norm_topk_prob: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k routing. Returns (weights [N, K] float32, expert ids [N, K]).
+
+    Softmax over ALL experts first, then top-k (Mixtral order); with
+    ``norm_topk_prob`` the selected weights are renormalised to sum to 1.
+    """
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    weights, ids = jax.lax.top_k(probs, num_experts_per_tok)
+    if norm_topk_prob:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    return weights, ids.astype(jnp.int32)
+
+
+def moe_ffn(
+    x: jnp.ndarray,  # [N, D] tokens (flattened batch*seq)
+    router_w: jnp.ndarray,  # [D, E]
+    w_gate: jnp.ndarray,  # [E, D, I]
+    w_up: jnp.ndarray,  # [E, D, I]
+    w_down: jnp.ndarray,  # [E, I, D]
+    num_experts_per_tok: int,
+    norm_topk_prob: bool = True,
+) -> jnp.ndarray:
+    """SwiGLU expert FFN with exact top-k dispatch. Returns [N, D].
+
+    Every (token, selected expert) pair is computed — the sort groups
+    pairs by expert so each expert sees one contiguous slab, and
+    ``ragged_dot`` runs the per-group matmuls without materialising a
+    one-hot dispatch tensor or imposing a capacity.
+    """
+    N, D = x.shape
+    E = router_w.shape[-1]
+    K = num_experts_per_tok
+    weights, ids = moe_router(x, router_w, K, norm_topk_prob)
+
+    flat_ids = ids.reshape(-1)  # [N*K]
+    # Stable sort so each token's k replicas keep a deterministic order.
+    order = jnp.argsort(flat_ids, stable=True)  # [N*K]
+    token_of = order // K  # originating token per sorted row
+    xs = jnp.take(x, token_of, axis=0)  # [N*K, D] in expert order
+    group_sizes = jnp.bincount(flat_ids, length=E)
+
+    g = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+    u = jax.lax.ragged_dot(xs, w_up, group_sizes)
+    h = (jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u)
+    out = jax.lax.ragged_dot(h, w_down, group_sizes)  # [N*K, D]
+
+    w_sorted = jnp.take(weights.reshape(-1), order)  # [N*K] float32
+    out = out.astype(jnp.float32) * w_sorted[:, None]
+    # Unsort + combine: scatter-add each replica back onto its token.
+    y = jnp.zeros((N, D), jnp.float32).at[token_of].add(out)
+    return y.astype(x.dtype)
+
+
+def moe_ffn_reference(
+    x, router_w, w_gate, w_up, w_down, num_experts_per_tok,
+    norm_topk_prob=True,
+):
+    """Dense oracle: every expert computes every token, combine masks the
+    unselected ones. O(E·N) FLOPs — tests only."""
+    N, D = x.shape
+    E = router_w.shape[-1]
+    weights, ids = moe_router(x, router_w, num_experts_per_tok, norm_topk_prob)
+    combine = jnp.zeros((N, E), jnp.float32)
+    combine = combine.at[jnp.arange(N)[:, None], ids].add(weights)
+    g = jnp.einsum("nd,edi->eni", x, w_gate)
+    u = jnp.einsum("nd,edi->eni", x, w_up)
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
+    out = jnp.einsum("eni,eid->end", h, w_down)  # [E, N, D]
+    y = jnp.einsum("ne,end->nd", combine, out.astype(jnp.float32))
+    return y.astype(x.dtype)

@@ -28,7 +28,8 @@ from dataclasses import dataclass
 
 logger = logging.getLogger(__name__)
 
-LEADER_KEY = "multihost/leader"
+LEADER_KEY_PREFIX = "multihost/"
+LEADER_LEASE_TTL_S = 30.0
 DEFAULT_DIST_PORT = 9911
 
 
@@ -39,13 +40,20 @@ class MultiNodeConfig:
     Mirrors ``engines.rs:41-50``: ``num_nodes`` (world size),
     ``node_rank`` (this process), ``leader_addr`` ("host:port" of rank
     0's jax.distributed coordinator; None = discover via the control
-    plane or, for rank 0, self-derive and publish).
+    plane or, for rank 0, self-derive and publish). ``deployment``
+    namespaces the published leader key so two multi-node graphs on one
+    coordinator don't read each other's address.
     """
 
     num_nodes: int = 1
     node_rank: int = 0
     leader_addr: str | None = None
     dist_port: int = DEFAULT_DIST_PORT
+    deployment: str = "default"
+
+    @property
+    def leader_key(self) -> str:
+        return f"{LEADER_KEY_PREFIX}{self.deployment}/leader"
 
     @property
     def is_multi_node(self) -> bool:
@@ -77,10 +85,15 @@ async def resolve_leader_addr(
     read it from the control plane (etcd-equivalent KV)."""
     if cfg.leader_addr:
         return cfg.leader_addr
+    key = cfg.leader_key
     if cfg.is_leader:
         addr = f"{detect_host_ip()}:{cfg.dist_port}"
         if discovery is not None:
-            await discovery.kv_put(LEADER_KEY, addr.encode())
+            # Lease-scoped publish: when the leader process dies, the
+            # coordinator expires the key within one TTL, so a relaunch's
+            # followers can't latch onto the previous run's address.
+            lease = await discovery.create_lease(ttl_s=LEADER_LEASE_TTL_S)
+            await discovery.kv_put(key, addr.encode(), lease=lease)
         return addr
     if discovery is None:
         raise ValueError(
@@ -88,11 +101,11 @@ async def resolve_leader_addr(
         )
     deadline = asyncio.get_running_loop().time() + timeout_s
     while asyncio.get_running_loop().time() < deadline:
-        value = await discovery.kv_get(LEADER_KEY)
+        value = await discovery.kv_get(key)
         if value:
             return value.decode()
         await asyncio.sleep(0.25)
-    raise TimeoutError(f"no leader address under {LEADER_KEY!r}")
+    raise TimeoutError(f"no leader address under {key!r}")
 
 
 def initialize_multihost(

@@ -86,3 +86,14 @@ def _vocab_size(tok_json: str) -> int:
     from tokenizers import Tokenizer
 
     return Tokenizer.from_file(tok_json).get_vocab_size()
+
+
+def free_port() -> int:
+    """Pick an OS-assigned free TCP port (shared by the e2e suites)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -16,16 +16,10 @@ import sys
 import aiohttp
 
 from dynamo_exp_tpu.sdk.service import discover_graph
+from .fixtures import free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def test_graph_discovery_shapes():
@@ -47,7 +41,7 @@ async def test_agg_graph_serves_openai_over_http(tiny_model_dir):
 
     server = CoordinatorServer()
     await server.start()
-    port = _free_port()
+    port = free_port()
     overrides = {
         "Frontend": {"served_model_name": "tiny", "port": port,
                      "host": "127.0.0.1"},

@@ -50,8 +50,27 @@ async def test_llmctl_add_list_remove(capsys):
         rows = json.loads(out)
         assert rows == [
             {"name": "foo/v1", "type": "chat",
-             "endpoint": "dyn://dynamo.TpuWorker.generate", "owner": "llmctl"}
+             "endpoint": "dyn://dynamo.TpuWorker.generate",
+             "owner": "llmctl-chat"}
         ]
+
+        # A completion registration under the SAME name coexists with
+        # the chat one, and remove is type-scoped.
+        add2 = parser.parse_args(
+            ["--coordinator", server.address, "http", "add",
+             "completion-model", "foo/v1", "TpuWorker.generate"]
+        )
+        assert await llmctl.add_model(drt, add2) == 0
+        assert len(await drt.discovery.kv_get_prefix(MODELS_PREFIX)) == 2
+
+        rm_comp = parser.parse_args(
+            ["--coordinator", server.address, "http", "remove",
+             "completion-model", "foo/v1"]
+        )
+        assert await llmctl.remove_model(drt, rm_comp) == 0
+        left = await drt.discovery.kv_get_prefix(MODELS_PREFIX)
+        assert len(left) == 1
+        assert ModelEntry.from_bytes(next(iter(left.values()))).model_type == "chat"
 
         rm = parser.parse_args(
             ["--coordinator", server.address, "http", "remove",

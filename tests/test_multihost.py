@@ -15,16 +15,10 @@ import subprocess
 import sys
 
 from dynamo_exp_tpu.parallel import MultiNodeConfig, resolve_leader_addr
+from .fixtures import free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 # ------------------------------------------------------------------- config
@@ -108,7 +102,7 @@ print(f"rank {rank} ok: {got:.4f}", flush=True)
 async def test_two_process_global_mesh_sharded_step():
     """Two 4-device CPU processes join one jax.distributed runtime,
     build a global dp=2 x tp=4 mesh, and agree on a sharded result."""
-    port = _free_port()
+    port = free_port()
     env = dict(
         os.environ,
         PYTHONPATH=REPO,

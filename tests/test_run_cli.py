@@ -18,7 +18,7 @@ import pytest
 
 from dynamo_exp_tpu.run import main_async, parse_args
 
-from .fixtures import build_tiny_model_dir
+from .fixtures import build_tiny_model_dir, free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -64,15 +64,6 @@ async def test_batch_driver_on_tpu_engine(tmp_path, capsys):
     assert stats["output_tok_s"] > 0
 
 
-def _free_port() -> int:
-    import socket
-
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
 
 async def test_three_process_serve_with_discovery(tmp_path):
     """coordinator + CLI worker subprocess + CLI HTTP ingress, dynamic
@@ -105,7 +96,7 @@ async def test_three_process_serve_with_discovery(tmp_path):
         text=True,
     )
 
-    port = _free_port()
+    port = free_port()
     ingress_opts = parse_args(
         [
             "in=http", "out=dyn://t.worker.generate",
