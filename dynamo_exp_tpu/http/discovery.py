@@ -35,9 +35,13 @@ class ModelWatcher:
         self.drt = drt
         self.manager = manager
         self.router_mode = router_mode
-        self._active: dict[str, str] = {}  # kv key -> model name
+        # kv key -> (model name, model_type): registrations are
+        # type-scoped (a name can be chat-only, completion-only, or
+        # both via separate entries — e.g. llmctl's per-type keys).
+        self._active: dict[str, tuple[str, str]] = {}
         self._task: asyncio.Task | None = None
         self._kv_routers: dict[str, object] = {}  # model name -> KvRouter
+        self._chains: dict[str, object] = {}  # model name -> engine chain
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._watch())
@@ -70,14 +74,32 @@ class ModelWatcher:
                 logger.exception("model watch stream broke; retrying")
                 await asyncio.sleep(1.0)
 
+    @staticmethod
+    def _types_of(model_type: str) -> set[str]:
+        return {"chat", "completion"} if model_type == "both" else {model_type}
+
+    def _covered_types(self, name: str) -> set[str]:
+        """Types currently provided for ``name`` by active entries."""
+        out: set[str] = set()
+        for n, t in self._active.values():
+            if n == name:
+                out |= self._types_of(t)
+        return out
+
     async def _apply(self, snapshot: dict[str, bytes]) -> None:
         for key in list(self._active):
             if key not in snapshot:
-                name = self._active.pop(key)
-                # N replicas write N keys for one model; drop the model
-                # only when the *last* replica's entry is gone.
-                if name not in self._active.values():
-                    self.manager.remove_model(name)
+                name, mtype = self._active.pop(key)
+                # N replicas write N keys for one model; drop each type
+                # only when the *last* entry providing it is gone.
+                still = self._covered_types(name)
+                gone = self._types_of(mtype) - still
+                if "chat" in gone:
+                    self.manager.remove_chat_model(name)
+                if "completion" in gone:
+                    self.manager.remove_completion_model(name)
+                if not still:
+                    self._chains.pop(name, None)
                     router = self._kv_routers.pop(name, None)
                     if router is not None:
                         await router.stop()  # drop its event sub + scrape loop
@@ -89,19 +111,27 @@ class ModelWatcher:
             # tokenizer path) must not block its siblings.
             try:
                 entry = ModelEntry.from_bytes(raw)
-                if entry.name not in self._active.values():
-                    # First replica: build the chain. The chain's client
-                    # watches every live instance of the endpoint, so
-                    # later replicas of the same endpoint ride it too.
-                    engine = await self._build_chain(entry)
-                    if entry.model_type in ("chat", "both"):
+                new_types = self._types_of(entry.model_type) - self._covered_types(
+                    entry.name
+                )
+                if new_types:
+                    # First entry for this (name, type): build — or
+                    # reuse — the chain. The chain's client watches
+                    # every live instance of the endpoint, so later
+                    # replicas of the same endpoint ride it too.
+                    engine = self._chains.get(entry.name)
+                    if engine is None:
+                        engine = await self._build_chain(entry)
+                        self._chains[entry.name] = engine
+                    if "chat" in new_types:
                         self.manager.add_chat_model(entry.name, engine)
-                    if entry.model_type in ("completion", "both"):
+                    if "completion" in new_types:
                         self.manager.add_completion_model(entry.name, engine)
                     logger.info(
-                        "model %s registered via %s", entry.name, entry.endpoint
+                        "model %s (%s) registered via %s",
+                        entry.name, entry.model_type, entry.endpoint,
                     )
-                self._active[key] = entry.name
+                self._active[key] = (entry.name, entry.model_type)
             except Exception:  # noqa: BLE001 - retried on next KV change
                 logger.exception("failed to register model entry %s", key)
 

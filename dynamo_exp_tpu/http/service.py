@@ -50,6 +50,12 @@ class ModelManager:
         self._chat.pop(name, None)
         self._completion.pop(name, None)
 
+    def remove_chat_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+
+    def remove_completion_model(self, name: str) -> None:
+        self._completion.pop(name, None)
+
     def chat_engine(self, name: str) -> AsyncEngine | None:
         return self._chat.get(name)
 

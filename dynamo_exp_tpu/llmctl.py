@@ -99,6 +99,12 @@ async def remove_model(drt, args) -> int:
         try:
             e = ModelEntry.from_bytes(raw)
         except (ValueError, TypeError, KeyError):
+            # Undecodable entries are unreachable by type-scoped remove;
+            # the untyped 'model' remove is the escape hatch that clears
+            # them (otherwise garbage keys would be undeletable forever).
+            if want == "both":
+                await drt.discovery.kv_delete(key)
+                removed += 1
             continue
         if want != "both" and e.model_type not in (want, "both"):
             continue

@@ -85,7 +85,15 @@ class ModelConfig:
             attention_bias=cfg.get(
                 "attention_bias", model_type in ("qwen2", "qwen2_moe")
             ),
-            sliding_window=cfg.get("sliding_window"),
+            # qwen2 ships a sliding_window value with
+            # use_sliding_window=false — honour the switch, or every
+            # HF-loaded qwen2 would lose the Pallas decode path and
+            # ring prefill for a window it never uses.
+            sliding_window=(
+                cfg.get("sliding_window")
+                if cfg.get("use_sliding_window", True)
+                else None
+            ),
             num_experts=cfg.get(
                 "num_local_experts", cfg.get("num_experts", 0)
             ) or 0,

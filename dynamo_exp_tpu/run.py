@@ -81,6 +81,10 @@ def parse_args(argv: list[str]):
                         "leader self-derives and publishes via --coordinator")
     p.add_argument("--dist-port", type=int, default=9911,
                    help="port the leader binds for jax.distributed")
+    p.add_argument("--deployment", default="default",
+                   help="namespaces the published leader address so two "
+                        "multi-node graphs on one coordinator don't read "
+                        "each other's")
     opts = p.parse_args(rest)
     opts.input, opts.output = io["in"], io["out"]
     return opts
@@ -451,6 +455,7 @@ async def main_async(opts) -> None:
                 node_rank=opts.node_rank,
                 leader_addr=opts.dist_leader or None,
                 dist_port=opts.dist_port,
+                deployment=opts.deployment,
             ),
             discovery=drt.discovery if opts.coordinator else None,
         )

@@ -18,7 +18,19 @@ from .engine import (
     ResponseStream,
 )
 from .logging import configure_logging
-from .pipeline import MapOperator, Operator, build_pipeline
+from .pipeline import (
+    Context,
+    MapOperator,
+    Operator,
+    PipelineNode,
+    PipelineOperator,
+    SegmentSink,
+    SegmentSource,
+    ServiceBackend,
+    ServiceFrontend,
+    build_pipeline,
+    build_segment,
+)
 from .pool import Pool, PoolItem
 from .push_router import NoInstancesError, PushRouter, RouterMode
 from .runtime import CancellationToken, Runtime, Worker
@@ -31,6 +43,7 @@ __all__ = [
     "CancellationToken",
     "Client",
     "Component",
+    "Context",
     "DistributedRuntime",
     "Endpoint",
     "EndpointAddress",
@@ -42,6 +55,8 @@ __all__ = [
     "Namespace",
     "NoInstancesError",
     "Operator",
+    "PipelineNode",
+    "PipelineOperator",
     "Pool",
     "PoolItem",
     "PushRouter",
@@ -49,9 +64,14 @@ __all__ = [
     "RouterMode",
     "Runtime",
     "RuntimeConfig",
+    "SegmentSink",
+    "SegmentSource",
     "ServedInstance",
+    "ServiceBackend",
+    "ServiceFrontend",
     "Worker",
     "annotated_stream",
     "build_pipeline",
+    "build_segment",
     "configure_logging",
 ]

@@ -80,3 +80,40 @@ async def test_bad_entry_does_not_block_siblings(tmp_path):
         assert await _wait_for(lambda: "tiny" in manager.model_names())
     finally:
         await watcher.close()
+
+
+async def test_type_scoped_registration_and_removal(tmp_path):
+    """One name registered as chat by one worker and completion by
+    another: both surfaces serve, and removing one type leaves the
+    other (the llmctl per-type registration flow)."""
+    model_dir = build_tiny_model_dir(str(tmp_path / "m"))
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    w_chat = DistributedRuntime(discovery=disc, request_plane=plane)
+    w_comp = DistributedRuntime(discovery=disc, request_plane=plane)
+    ingress = DistributedRuntime(discovery=disc, request_plane=plane)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(ingress, manager)
+    await watcher.start()
+    try:
+        ep1 = w_chat.namespace("t").component("w").endpoint("generate")
+        ep2 = w_comp.namespace("t").component("w").endpoint("generate")
+        await register_llm(w_chat, ep1, model_dir, "tiny", model_type="chat")
+        assert await _wait_for(lambda: manager.chat_engine("tiny") is not None)
+        assert manager.completion_engine("tiny") is None
+
+        # Second entry under the SAME name adds the completion surface.
+        await register_llm(w_comp, ep2, model_dir, "tiny", model_type="completion")
+        assert await _wait_for(
+            lambda: manager.completion_engine("tiny") is not None
+        )
+        assert manager.chat_engine("tiny") is not None
+
+        # Completion worker dies -> only the completion surface drops.
+        lease = await w_comp.primary_lease()
+        await lease.revoke()
+        assert await _wait_for(lambda: manager.completion_engine("tiny") is None)
+        assert manager.chat_engine("tiny") is not None
+    finally:
+        await watcher.close()

@@ -255,15 +255,17 @@ def _parity_check(tmp_path, hf_model, hf_config, n_tokens=12, atol=2e-3):
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=atol)
 
 
-@pytest.fixture(autouse=True)
-def _quiet_hf(monkeypatch):
+@pytest.fixture
+def _hf_env(monkeypatch):
+    """Requested only by the HF parity tests — NOT autouse, so the
+    pure-JAX tests above keep running on hosts without torch."""
     monkeypatch.setenv("TRANSFORMERS_VERBOSITY", "error")
     monkeypatch.setenv("HF_HUB_OFFLINE", "1")
     torch = pytest.importorskip("torch")
     torch.manual_seed(0)  # deterministic random init → stable tolerances
 
 
-def test_hf_parity_qwen2(tmp_path):
+def test_hf_parity_qwen2(tmp_path, _hf_env):
     transformers = pytest.importorskip("transformers")
     c = transformers.Qwen2Config(
         vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -274,7 +276,7 @@ def test_hf_parity_qwen2(tmp_path):
     _parity_check(tmp_path, transformers.Qwen2ForCausalLM(c), c)
 
 
-def test_hf_parity_mistral_sliding_window(tmp_path):
+def test_hf_parity_mistral_sliding_window(tmp_path, _hf_env):
     transformers = pytest.importorskip("transformers")
     c = transformers.MistralConfig(
         vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -289,7 +291,7 @@ def test_hf_parity_mistral_sliding_window(tmp_path):
     _parity_check(tmp_path, model, c, n_tokens=16, atol=5e-3)
 
 
-def test_hf_parity_mixtral(tmp_path):
+def test_hf_parity_mixtral(tmp_path, _hf_env):
     transformers = pytest.importorskip("transformers")
     c = transformers.MixtralConfig(
         vocab_size=128, hidden_size=32, intermediate_size=48,

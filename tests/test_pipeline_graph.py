@@ -1,0 +1,193 @@
+"""Pipeline node-graph tests: frontends, operators, edge nodes, segment
+cut points across a real transport.
+
+Reference capability anchors:
+``lib/runtime/src/pipeline/nodes.rs:1-351`` (Source/Sink/Operator/
+ServiceFrontend/ServiceBackend/SegmentSource/SegmentSink),
+``context.rs:1-467`` (Context id/registry/stages propagation).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_exp_tpu.runtime import DistributedRuntime, LambdaEngine
+from dynamo_exp_tpu.runtime.engine import AsyncEngineContext, ResponseStream
+from dynamo_exp_tpu.runtime.pipeline import (
+    Context,
+    MapOperator,
+    Operator,
+    PipelineNode,
+    PipelineOperator,
+    SegmentSink,
+    SegmentSource,
+    ServiceBackend,
+    ServiceFrontend,
+    build_segment,
+)
+
+
+def counting_engine():
+    """Engine yielding request['n'] integers 0..n-1."""
+
+    async def gen(request, ctx):
+        for i in range(request["n"]):
+            yield {"i": i}
+
+    return LambdaEngine(gen)
+
+
+async def drain(stream):
+    return [item async for item in stream]
+
+
+# ------------------------------------------------------------- basic graph
+async def test_frontend_backend_roundtrip():
+    front = ServiceFrontend()
+    front.link(ServiceBackend(counting_engine()))
+    out = await drain(await front.generate({"n": 3}))
+    assert out == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+
+async def test_edge_nodes_forward_and_backward():
+    front = ServiceFrontend()
+    front.link(
+        PipelineNode(forward=lambda r: {"n": r["n"] + 1})
+    ).link(
+        PipelineNode(backward=lambda item: {"i": item["i"] * 10})
+    ).link(ServiceBackend(counting_engine()))
+    out = await drain(await front.generate({"n": 1}))
+    assert out == [{"i": 0}, {"i": 10}]
+
+
+async def test_pipeline_operator_sees_both_paths():
+    """A bidirectional operator carries request info onto the response
+    path — the capability edge nodes lack by design (nodes.rs doc)."""
+
+    class Tagger(Operator):
+        async def generate(self, request, next_engine, context):
+            tag = request.pop("tag")
+            stream = await next_engine.generate(request, context)
+
+            async def wrapped():
+                async for item in stream:
+                    yield {**item, "tag": tag}
+
+            return ResponseStream(wrapped(), context)
+
+    front = build_segment([Tagger()], sink=counting_engine())
+    out = await drain(await front.generate({"n": 2, "tag": "x"}))
+    assert out == [{"i": 0, "tag": "x"}, {"i": 1, "tag": "x"}]
+
+
+async def test_context_propagates_id_values_stages():
+    seen = {}
+
+    class Probe(Operator):
+        async def generate(self, request, next_engine, context):
+            seen["id"] = context.id
+            return await next_engine.generate(request, context)
+
+    front = build_segment([Probe()], sink=counting_engine())
+    ctx = AsyncEngineContext("req-42")
+    wrapped = Context({"n": 1}, controller=ctx)
+    wrapped.insert("user", "alice")
+    stream = await front.generate(wrapped)
+    await drain(stream)
+    assert seen["id"] == "req-42"
+    assert wrapped.get("user") == "alice"
+    assert wrapped.stages[0] == "ServiceFrontend"
+    assert "Probe" in wrapped.stages
+
+
+async def test_backend_error_propagates_to_caller():
+    async def boom(request, ctx):
+        raise RuntimeError("engine exploded")
+        yield  # pragma: no cover
+
+    class Boom:
+        async def generate(self, request, context=None):
+            raise RuntimeError("engine exploded")
+
+    front = ServiceFrontend()
+    front.link(ServiceBackend(Boom()))
+    with pytest.raises(RuntimeError, match="engine exploded"):
+        await front.generate({"n": 1})
+
+
+async def test_unattached_segment_sink_fails_fast():
+    front = ServiceFrontend()
+    front.link(SegmentSink())
+    with pytest.raises(RuntimeError, match="no transport"):
+        await front.generate({"n": 1})
+
+
+async def test_kill_stops_stream_mid_graph():
+    front = ServiceFrontend()
+    front.link(ServiceBackend(counting_engine()))
+    ctx = AsyncEngineContext()
+    stream = await front.generate({"n": 100}, ctx)
+    got = []
+    async for item in stream:
+        got.append(item)
+        if len(got) == 2:
+            ctx.kill()
+    assert len(got) == 2
+
+
+# ------------------------------------------------- segment across transport
+async def test_segment_cut_across_real_endpoint():
+    """ingress segment → SegmentSink → (request plane) → SegmentSource →
+    worker segment, over the in-process transport — the reference's
+    frontend-node/worker-node split (SURVEY.md §3 ingress/worker call
+    stacks)."""
+    from dynamo_exp_tpu.runtime import Annotated, PushRouter, RouterMode
+
+    drt = DistributedRuntime.detached()
+
+    # Worker side: SegmentSource feeding a local graph ending in the
+    # engine; served as a normal endpoint handler (which speaks
+    # Annotated frames on the wire).
+    async def annotated_counting(request, ctx):
+        for i in range(request["n"]):
+            yield Annotated.from_data({"i": i}).to_dict()
+
+    worker_seg = SegmentSource()
+    worker_seg.link(
+        PipelineNode(forward=lambda r: {"n": r["n"] * 2})
+    ).link(ServiceBackend(LambdaEngine(annotated_counting)))
+    ep = drt.namespace("seg").component("worker").endpoint("generate")
+    await ep.serve_endpoint(worker_seg.endpoint_handler())
+
+    # Ingress side: frontend → backward-unwrap node → SegmentSink
+    # attached to a PushRouter over the endpoint's live instances.
+    client = await ep.client()
+    sink = SegmentSink()
+    front = ServiceFrontend()
+    front.link(
+        PipelineNode(backward=lambda fr: {"got": fr["i"]})
+    ).link(sink)
+    sink.attach(PushRouter(client, RouterMode.RANDOM))
+
+    out = await drain(await front.generate({"n": 2}))
+    assert out == [{"got": 0}, {"got": 1}, {"got": 2}, {"got": 3}]
+    await drt.close()
+
+
+# -------------------------------------------------------------- build sugar
+async def test_build_segment_mixes_operators_and_nodes():
+    front = build_segment(
+        [
+            MapOperator(map_request=lambda r: {"n": r["n"] + 1}),
+            PipelineNode(backward=lambda item: item["i"]),
+        ],
+        sink=counting_engine(),
+    )
+    assert await drain(await front.generate({"n": 0})) == [0]
+
+
+async def test_build_segment_rejects_double_link():
+    front = ServiceFrontend()
+    front.link(ServiceBackend(counting_engine()))
+    with pytest.raises(RuntimeError, match="already linked"):
+        front.link(ServiceBackend(counting_engine()))
