@@ -1,0 +1,23 @@
+"""Deploy tier: artifact build, artifact/deployment store, K8s rendering.
+
+Capability parity with the reference's deployment stack
+(``/root/reference/deploy/dynamo/``): ``dynamo build`` Bento-style
+artifact packaging (``cli/bentos.py``), the api-store artifact registry
+(``api-store/ai_dynamo_store/api/``), and the Go K8s operator's
+manifest generation (``operator/``) — redesigned for TPU clusters:
+artifacts are plain content-addressed tarballs of the SDK graph, and
+rendering targets GKE TPU node pools (``google.com/tpu`` resources +
+TPU node selectors) with the self-hosted coordinator as the control
+plane instead of etcd+NATS.
+"""
+
+from .artifact import ArtifactManifest, build_artifact, read_manifest
+from .k8s import render_graph_manifests, to_yaml
+
+__all__ = [
+    "ArtifactManifest",
+    "build_artifact",
+    "read_manifest",
+    "render_graph_manifests",
+    "to_yaml",
+]

@@ -1,0 +1,208 @@
+"""Artifact + deployment registry service (api-store).
+
+Reference parity: ``/root/reference/deploy/dynamo/api-store/
+ai_dynamo_store/api/{dynamo.py,deployments.py,components.py}`` — a REST
+store that ``dynamo deploy`` pushes built pipelines to and the operator
+reads from. TPU redesign: aiohttp (the image's only HTTP server lib),
+content-addressed tarballs on local disk, deployments as JSON records
+holding the rendered K8s manifests.
+
+Routes:
+  POST   /api/v1/artifacts                (body = .tar.gz)  -> {name, version}
+  GET    /api/v1/artifacts                -> [manifest, ...]
+  GET    /api/v1/artifacts/{name}/{ver}   -> tarball
+  DELETE /api/v1/artifacts/{name}/{ver}
+  POST   /api/v1/deployments              {artifact, version, image, name?}
+  GET    /api/v1/deployments              -> [record, ...]
+  GET    /api/v1/deployments/{name}       -> record (incl. manifests YAML)
+  DELETE /api/v1/deployments/{name}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+from aiohttp import web
+
+from .artifact import ArtifactManifest, read_manifest
+from .k8s import render_graph_manifests, to_yaml
+
+
+class ApiStore:
+    def __init__(self, store_dir: str):
+        self.store_dir = store_dir
+        os.makedirs(os.path.join(store_dir, "artifacts"), exist_ok=True)
+        os.makedirs(os.path.join(store_dir, "deployments"), exist_ok=True)
+        self._runner: web.AppRunner | None = None
+        self.address: str | None = None
+
+    # ------------------------------------------------------------ storage
+    def _artifact_path(self, name: str, version: str) -> str:
+        safe = f"{name}--{version}".replace("/", "_")
+        return os.path.join(self.store_dir, "artifacts", safe + ".tar.gz")
+
+    def _deployment_path(self, name: str) -> str:
+        return os.path.join(
+            self.store_dir, "deployments", name.replace("/", "_") + ".json"
+        )
+
+    def list_artifacts(self) -> list[ArtifactManifest]:
+        out = []
+        adir = os.path.join(self.store_dir, "artifacts")
+        for fn in sorted(os.listdir(adir)):
+            if fn.endswith(".tar.gz"):
+                out.append(read_manifest(os.path.join(adir, fn)))
+        return out
+
+    # ------------------------------------------------------------- routes
+    async def _put_artifact(self, request: web.Request) -> web.Response:
+        body = await request.read()
+        with tempfile.NamedTemporaryFile(
+            dir=self.store_dir, suffix=".tar.gz", delete=False
+        ) as tmp:
+            tmp.write(body)
+            tmp_path = tmp.name
+        try:
+            manifest = read_manifest(tmp_path)
+        except Exception as e:
+            os.unlink(tmp_path)
+            return web.json_response(
+                {"error": f"not a valid artifact: {e}"}, status=400
+            )
+        os.replace(tmp_path, self._artifact_path(manifest.name, manifest.version))
+        return web.json_response(
+            {"name": manifest.name, "version": manifest.version}
+        )
+
+    async def _list_artifacts(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            [json.loads(m.to_json()) for m in self.list_artifacts()]
+        )
+
+    async def _get_artifact(self, request: web.Request) -> web.Response:
+        path = self._artifact_path(
+            request.match_info["name"], request.match_info["version"]
+        )
+        if not os.path.exists(path):
+            return web.json_response({"error": "not found"}, status=404)
+        return web.FileResponse(path)
+
+    async def _delete_artifact(self, request: web.Request) -> web.Response:
+        path = self._artifact_path(
+            request.match_info["name"], request.match_info["version"]
+        )
+        if not os.path.exists(path):
+            return web.json_response({"error": "not found"}, status=404)
+        os.unlink(path)
+        return web.json_response({"deleted": True})
+
+    async def _create_deployment(self, request: web.Request) -> web.Response:
+        spec = await request.json()
+        name = spec.get("name") or spec.get("artifact")
+        art_path = self._artifact_path(
+            spec.get("artifact", ""), spec.get("version", "")
+        )
+        if not os.path.exists(art_path):
+            return web.json_response(
+                {"error": "artifact not in store"}, status=404
+            )
+        manifest = read_manifest(art_path)
+        docs = render_graph_manifests(
+            manifest,
+            image=spec.get("image", "dynamo-exp-tpu:latest"),
+            deployment=name,
+        )
+        record = {
+            "name": name,
+            "artifact": manifest.name,
+            "version": manifest.version,
+            "image": spec.get("image", "dynamo-exp-tpu:latest"),
+            "created_unix": time.time(),
+            "manifests_yaml": to_yaml(docs),
+            "services": [s.name for s in manifest.services],
+        }
+        with open(self._deployment_path(name), "w") as f:
+            json.dump(record, f)
+        return web.json_response({"name": name, "services": record["services"]})
+
+    async def _list_deployments(self, request: web.Request) -> web.Response:
+        ddir = os.path.join(self.store_dir, "deployments")
+        out = []
+        for fn in sorted(os.listdir(ddir)):
+            with open(os.path.join(ddir, fn)) as f:
+                rec = json.load(f)
+            out.append({k: rec[k] for k in ("name", "artifact", "version")})
+        return web.json_response(out)
+
+    async def _get_deployment(self, request: web.Request) -> web.Response:
+        path = self._deployment_path(request.match_info["name"])
+        if not os.path.exists(path):
+            return web.json_response({"error": "not found"}, status=404)
+        with open(path) as f:
+            return web.json_response(json.load(f))
+
+    async def _delete_deployment(self, request: web.Request) -> web.Response:
+        path = self._deployment_path(request.match_info["name"])
+        if not os.path.exists(path):
+            return web.json_response({"error": "not found"}, status=404)
+        os.unlink(path)
+        return web.json_response({"deleted": True})
+
+    # ---------------------------------------------------------- lifecycle
+    def app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_post("/api/v1/artifacts", self._put_artifact)
+        app.router.add_get("/api/v1/artifacts", self._list_artifacts)
+        app.router.add_get(
+            "/api/v1/artifacts/{name}/{version}", self._get_artifact
+        )
+        app.router.add_delete(
+            "/api/v1/artifacts/{name}/{version}", self._delete_artifact
+        )
+        app.router.add_post("/api/v1/deployments", self._create_deployment)
+        app.router.add_get("/api/v1/deployments", self._list_deployments)
+        app.router.add_get("/api/v1/deployments/{name}", self._get_deployment)
+        app.router.add_delete(
+            "/api/v1/deployments/{name}", self._delete_deployment
+        )
+        return app
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._runner = web.AppRunner(self.app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        real_port = self._runner.addresses[0][1]
+        self.address = f"http://{host}:{real_port}"
+        return self.address
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def main() -> None:  # pragma: no cover - CLI entry
+    import argparse
+    import asyncio
+
+    p = argparse.ArgumentParser(description="dynamo-tpu artifact store")
+    p.add_argument("--store-dir", default="./dynamo-store")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7070)
+    args = p.parse_args()
+
+    async def run():
+        store = ApiStore(args.store_dir)
+        addr = await store.start(args.host, args.port)
+        print(f"api-store on {addr}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

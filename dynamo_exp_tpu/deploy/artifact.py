@@ -1,0 +1,161 @@
+"""Build deployable artifacts from SDK graphs.
+
+Reference parity: ``deploy/dynamo/cli/bentos.py`` (Bento build) +
+``pipeline.py`` (graph packaging). TPU-first redesign: no Bento
+machinery — an artifact is a content-addressed ``.tar.gz`` holding
+
+- ``manifest.json`` — graph target, per-service specs (name, namespace,
+  workers, resources, endpoints, dependencies), config YAML, digest.
+- the graph's source tree (the packages the graph imports from, relative
+  to the build root), so a runner can ``PYTHONPATH=artifact`` serve it.
+
+The digest is a sha256 over the manifest body (with the digest field
+empty) plus every packed file, so two builds of identical source are
+the same version — the api-store dedupes on it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib
+import io
+import json
+import os
+import tarfile
+import time
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class ServiceManifest:
+    name: str
+    namespace: str
+    workers: int
+    resources: dict
+    endpoints: list[str]
+    depends_on: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ArtifactManifest:
+    name: str
+    graph_target: str  # "package.module:RootService"
+    services: list[ServiceManifest]
+    config_yaml: str = ""
+    version: str = ""  # content digest, filled by build
+    created_unix: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "ArtifactManifest":
+        d = json.loads(raw)
+        d["services"] = [ServiceManifest(**s) for s in d["services"]]
+        return cls(**d)
+
+
+def _load_graph(graph_target: str):
+    mod_name, _, cls_name = graph_target.partition(":")
+    if not cls_name:
+        raise ValueError(f"graph target must be module:Class, got {graph_target!r}")
+    mod = importlib.import_module(mod_name)
+    return getattr(mod, cls_name)
+
+
+def _spec_dependencies(spec) -> list[str]:
+    """Names of services this one depends() on (SDK dependency edges)."""
+    from ..sdk.dependency import depends
+    from ..sdk.service import get_spec
+
+    return [
+        get_spec(val.target).name
+        for val in vars(spec.cls).values()
+        if isinstance(val, depends)
+    ]
+
+
+def manifest_for_graph(
+    graph_target: str, name: str | None = None, config_path: str | None = None
+) -> ArtifactManifest:
+    from ..sdk.service import discover_graph
+
+    root = _load_graph(graph_target)
+    specs = discover_graph(root)
+    services = [
+        ServiceManifest(
+            name=s.name,
+            namespace=s.namespace,
+            workers=s.workers,
+            resources=dict(s.resources),
+            endpoints=sorted(s.endpoints),
+            depends_on=_spec_dependencies(s),
+        )
+        for s in specs
+    ]
+    config_yaml = ""
+    if config_path:
+        with open(config_path) as f:
+            config_yaml = f.read()
+    return ArtifactManifest(
+        name=name or root.__name__.lower(),
+        graph_target=graph_target,
+        services=services,
+        config_yaml=config_yaml,
+    )
+
+
+def _iter_source_files(src_root: str, packages: list[str]):
+    for pkg in packages:
+        base = os.path.join(src_root, pkg.replace(".", os.sep))
+        if os.path.isfile(base + ".py"):
+            yield base + ".py"
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith((".py", ".yaml", ".json")):
+                    yield os.path.join(dirpath, fn)
+
+
+def build_artifact(
+    graph_target: str,
+    out_path: str,
+    *,
+    name: str | None = None,
+    config_path: str | None = None,
+    src_root: str = ".",
+    packages: list[str] | None = None,
+) -> ArtifactManifest:
+    """Pack the graph into ``out_path`` (.tar.gz); returns the manifest
+    (with ``version`` = content digest)."""
+    manifest = manifest_for_graph(graph_target, name, config_path)
+    if packages is None:
+        packages = [graph_target.partition(":")[0].split(".")[0]]
+
+    files = sorted(_iter_source_files(src_root, packages))
+    digest = hashlib.sha256()
+    digest.update(manifest.to_json().encode())
+    for path in files:
+        digest.update(os.path.relpath(path, src_root).encode())
+        with open(path, "rb") as f:
+            digest.update(f.read())
+    manifest.version = digest.hexdigest()[:16]
+    manifest.created_unix = time.time()
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        body = manifest.to_json().encode()
+        info = tarfile.TarInfo("manifest.json")
+        info.size = len(body)
+        tar.addfile(info, io.BytesIO(body))
+        for path in files:
+            tar.add(path, arcname=os.path.relpath(path, src_root))
+    return manifest
+
+
+def read_manifest(artifact_path: str) -> ArtifactManifest:
+    with tarfile.open(artifact_path, "r:gz") as tar:
+        f = tar.extractfile("manifest.json")
+        if f is None:
+            raise ValueError(f"{artifact_path}: no manifest.json")
+        return ArtifactManifest.from_json(f.read().decode())

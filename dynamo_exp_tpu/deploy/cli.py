@@ -1,0 +1,113 @@
+"""``deploy`` CLI: build, push, list, render, deploy.
+
+Reference parity: ``dynamo build`` / ``dynamo deploy``
+(``/root/reference/deploy/dynamo/cli/{deployment.py,bentos.py}``).
+
+    python -m dynamo_exp_tpu.deploy.cli build examples.llm.graphs.agg:Frontend \
+        -o agg.tar.gz -f examples/llm/configs/agg.yaml
+    python -m dynamo_exp_tpu.deploy.cli render agg.tar.gz --image my/img > k8s.yaml
+    python -m dynamo_exp_tpu.deploy.cli push agg.tar.gz --store http://host:7070
+    python -m dynamo_exp_tpu.deploy.cli deploy NAME VERSION --store ... --image ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from .artifact import build_artifact, read_manifest
+from .k8s import render_graph_manifests, to_yaml
+
+
+def _cmd_build(args) -> int:
+    manifest = build_artifact(
+        args.target,
+        args.output,
+        name=args.name,
+        config_path=args.config,
+        src_root=args.src_root,
+        packages=args.packages.split(",") if args.packages else None,
+    )
+    print(json.dumps({"name": manifest.name, "version": manifest.version,
+                      "services": [s.name for s in manifest.services]}))
+    return 0
+
+
+def _cmd_render(args) -> int:
+    manifest = read_manifest(args.artifact)
+    docs = render_graph_manifests(
+        manifest, image=args.image, deployment=args.deployment
+    )
+    sys.stdout.write(to_yaml(docs))
+    return 0
+
+
+async def _push(args) -> int:
+    import aiohttp
+
+    with open(args.artifact, "rb") as f:
+        body = f.read()
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"{args.store}/api/v1/artifacts", data=body) as r:
+            print(json.dumps(await r.json()))
+            return 0 if r.status == 200 else 1
+
+
+async def _deploy(args) -> int:
+    import aiohttp
+
+    payload = {
+        "artifact": args.name,
+        "version": args.version,
+        "image": args.image,
+        "name": args.deployment or args.name,
+    }
+    async with aiohttp.ClientSession() as s:
+        async with s.post(f"{args.store}/api/v1/deployments", json=payload) as r:
+            print(json.dumps(await r.json()))
+            return 0 if r.status == 200 else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="deploy", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    b = sub.add_parser("build", help="pack an SDK graph into an artifact")
+    b.add_argument("target", help="pkg.module:RootService")
+    b.add_argument("-o", "--output", required=True)
+    b.add_argument("-f", "--config", default=None)
+    b.add_argument("--name", default=None)
+    b.add_argument("--src-root", default=".")
+    b.add_argument("--packages", default=None,
+                   help="comma-separated packages to pack (default: graph's root pkg)")
+
+    r = sub.add_parser("render", help="render K8s manifests for an artifact")
+    r.add_argument("artifact")
+    r.add_argument("--image", default="dynamo-exp-tpu:latest")
+    r.add_argument("--deployment", default=None)
+
+    pu = sub.add_parser("push", help="upload an artifact to the api-store")
+    pu.add_argument("artifact")
+    pu.add_argument("--store", required=True)
+
+    d = sub.add_parser("deploy", help="create a deployment record in the store")
+    d.add_argument("name")
+    d.add_argument("version")
+    d.add_argument("--store", required=True)
+    d.add_argument("--image", default="dynamo-exp-tpu:latest")
+    d.add_argument("--deployment", default=None)
+
+    args = p.parse_args(argv)
+    if args.command == "build":
+        return _cmd_build(args)
+    if args.command == "render":
+        return _cmd_render(args)
+    if args.command == "push":
+        return asyncio.run(_push(args))
+    return asyncio.run(_deploy(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,218 @@
+"""Render K8s manifests for a graph artifact onto TPU node pools.
+
+Reference parity: the Go operator's reconcilers create a Deployment +
+Service per component (``/root/reference/deploy/dynamo/operator/``,
+CRDs ``DynamoDeployment``/``DynamoComponent``) and the helm charts wire
+etcd+NATS. TPU-first redesign, rendered statically instead of
+reconciled by a cluster operator:
+
+- one coordinator Deployment+Service is the whole control plane (the
+  self-hosted etcd+NATS replacement in ``runtime/transports/
+  coordinator.py``), every component gets ``DYN_COORDINATOR`` pointing
+  at it;
+- a service requesting ``resources={"tpu": N}`` renders ``google.com/
+  tpu: N`` limits plus GKE TPU node selectors
+  (``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``);
+- multi-host TPU slices (``tpu_hosts > 1``) render as a headless
+  Service + one indexed Deployment per host rank carrying the
+  ``--num-nodes/--node-rank`` multihost flags.
+
+The output is ``kubectl apply``-ready YAML; no operator pod needed.
+"""
+
+from __future__ import annotations
+
+import yaml
+
+from .artifact import ArtifactManifest, ServiceManifest
+
+COORDINATOR_PORT = 6650
+DEFAULT_TPU_ACCEL = "tpu-v5-lite-podslice"
+
+
+def _meta(name: str, deployment: str, extra: dict | None = None) -> dict:
+    labels = {
+        "app.kubernetes.io/name": name,
+        "app.kubernetes.io/part-of": deployment,
+        "app.kubernetes.io/managed-by": "dynamo-exp-tpu",
+    }
+    if extra:
+        labels.update(extra)
+    return {"name": name, "labels": labels}
+
+
+def render_coordinator(deployment: str, image: str) -> list[dict]:
+    name = f"{deployment}-coordinator"
+    labels = {"app.kubernetes.io/name": name}
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta(name, deployment),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": dict(labels)},
+                "template": {
+                    "metadata": {"labels": dict(labels)},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "coordinator",
+                                "image": image,
+                                "command": [
+                                    "python", "-m",
+                                    "dynamo_exp_tpu.runtime.transports.coordinator",
+                                    "--host", "0.0.0.0",
+                                    "--port", str(COORDINATOR_PORT),
+                                ],
+                                "ports": [{"containerPort": COORDINATOR_PORT}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(name, deployment),
+            "spec": {
+                "selector": labels,
+                "ports": [{"port": COORDINATOR_PORT}],
+            },
+        },
+    ]
+
+
+def _tpu_pod_bits(resources: dict) -> tuple[dict, dict]:
+    """(nodeSelector, container resources) for a service's request."""
+    tpu = int(resources.get("tpu", 0))
+    if tpu <= 0:
+        limits = {
+            k: str(v) for k, v in resources.items() if k in ("cpu", "memory")
+        }
+        return {}, ({"limits": limits} if limits else {})
+    selector = {
+        "cloud.google.com/gke-tpu-accelerator": resources.get(
+            "tpu_accelerator", DEFAULT_TPU_ACCEL
+        ),
+        "cloud.google.com/gke-tpu-topology": resources.get(
+            "tpu_topology", f"{min(tpu, 2)}x{max(1, tpu // 2)}"
+        ),
+    }
+    return selector, {"limits": {"google.com/tpu": str(tpu)}}
+
+
+def render_component(
+    svc: ServiceManifest,
+    deployment: str,
+    image: str,
+    graph_target: str,
+    config_map: str | None,
+) -> list[dict]:
+    """Deployment (+ per-rank variants for multi-host slices) for one
+    service of the graph."""
+    coord = f"{deployment}-coordinator:{COORDINATOR_PORT}"
+    hosts = int(svc.resources.get("tpu_hosts", 1))
+    selector_extra, container_res = _tpu_pod_bits(svc.resources)
+    docs: list[dict] = []
+
+    def one(rank: int | None) -> dict:
+        name = f"{deployment}-{svc.name.lower()}"
+        if rank is not None:
+            name = f"{name}-{rank}"
+        labels = {"app.kubernetes.io/name": name}
+        cmd = [
+            "python", "-m", "dynamo_exp_tpu.sdk.serve", graph_target,
+            "--service-name", svc.name,
+        ]
+        if config_map:
+            cmd += ["-f", "/etc/dynamo/config.yaml"]
+        if hosts > 1:
+            cmd += [
+                "--num-nodes", str(hosts),
+                "--node-rank", str(rank),
+                "--deployment", deployment,
+            ]
+        container = {
+            "name": svc.name.lower(),
+            "image": image,
+            "command": cmd,
+            "env": [{"name": "DYN_COORDINATOR", "value": coord}],
+        }
+        if container_res:
+            container["resources"] = container_res
+        if config_map:
+            container["volumeMounts"] = [
+                {"name": "config", "mountPath": "/etc/dynamo"}
+            ]
+        pod: dict = {"containers": [container]}
+        if selector_extra:
+            pod["nodeSelector"] = selector_extra
+        if config_map:
+            pod["volumes"] = [
+                {"name": "config", "configMap": {"name": config_map}}
+            ]
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta(name, deployment),
+            "spec": {
+                "replicas": svc.workers if rank is None else 1,
+                "selector": {"matchLabels": dict(labels)},
+                "template": {"metadata": {"labels": dict(labels)}, "spec": pod},
+            },
+        }
+
+    if hosts > 1:
+        docs += [one(rank) for rank in range(hosts)]
+    else:
+        docs.append(one(None))
+    return docs
+
+
+def render_graph_manifests(
+    manifest: ArtifactManifest,
+    *,
+    image: str,
+    deployment: str | None = None,
+    http_port: int = 8080,
+) -> list[dict]:
+    """Full manifest set: coordinator, config, every component, and an
+    HTTP Service in front of the graph's first service (the Frontend by
+    SDK convention — last in dependency order)."""
+    deployment = deployment or manifest.name
+    docs = render_coordinator(deployment, image)
+    config_map = None
+    if manifest.config_yaml:
+        config_map = f"{deployment}-config"
+        docs.append(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": _meta(config_map, deployment),
+                "data": {"config.yaml": manifest.config_yaml},
+            }
+        )
+    for svc in manifest.services:
+        docs += render_component(
+            svc, deployment, image, manifest.graph_target, config_map
+        )
+    front = manifest.services[-1]  # discover_graph is dependencies-first
+    front_name = f"{deployment}-{front.name.lower()}"
+    docs.append(
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta(f"{deployment}-http", deployment),
+            "spec": {
+                "selector": {"app.kubernetes.io/name": front_name},
+                "ports": [{"port": http_port, "targetPort": http_port}],
+            },
+        }
+    )
+    return docs
+
+
+def to_yaml(docs: list[dict]) -> str:
+    return yaml.safe_dump_all(docs, sort_keys=False)

@@ -40,8 +40,13 @@ class ModelWatcher:
         # both via separate entries — e.g. llmctl's per-type keys).
         self._active: dict[str, tuple[str, str]] = {}
         self._task: asyncio.Task | None = None
-        self._kv_routers: dict[str, object] = {}  # model name -> KvRouter
-        self._chains: dict[str, object] = {}  # model name -> engine chain
+        # Chains/routers are keyed by the serving identity — (name,
+        # endpoint, mdc_key) — NOT by name alone: one name's chat and
+        # completion entries may point at different endpoints (different
+        # workers), and each type's traffic must ride its own entry's
+        # chain.
+        self._kv_routers: dict[tuple, object] = {}
+        self._chains: dict[tuple, object] = {}
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._watch())
@@ -99,9 +104,10 @@ class ModelWatcher:
                 if "completion" in gone:
                     self.manager.remove_completion_model(name)
                 if not still:
-                    self._chains.pop(name, None)
-                    router = self._kv_routers.pop(name, None)
-                    if router is not None:
+                    for ck in [k for k in self._chains if k[0] == name]:
+                        del self._chains[ck]
+                    for rk in [k for k in self._kv_routers if k[0] == name]:
+                        router = self._kv_routers.pop(rk)
                         await router.stop()  # drop its event sub + scrape loop
                     logger.info("model %s removed (last worker gone)", name)
         for key, raw in snapshot.items():
@@ -116,13 +122,15 @@ class ModelWatcher:
                 )
                 if new_types:
                     # First entry for this (name, type): build — or
-                    # reuse — the chain. The chain's client watches
-                    # every live instance of the endpoint, so later
-                    # replicas of the same endpoint ride it too.
-                    engine = self._chains.get(entry.name)
+                    # reuse — the chain for this entry's serving
+                    # identity. The chain's client watches every live
+                    # instance of the endpoint, so later replicas of
+                    # the same endpoint ride it too.
+                    ck = (entry.name, entry.endpoint, entry.mdc_key)
+                    engine = self._chains.get(ck)
                     if engine is None:
                         engine = await self._build_chain(entry)
-                        self._chains[entry.name] = engine
+                        self._chains[ck] = engine
                     if "chat" in new_types:
                         self.manager.add_chat_model(entry.name, engine)
                     if "completion" in new_types:
@@ -154,8 +162,9 @@ class ModelWatcher:
         if kv_router is not None:
             # A retry after a partially-failed registration may rebuild
             # the chain; stop the superseded router or it scrapes forever.
-            old = self._kv_routers.pop(entry.name, None)
+            rk = (entry.name, entry.endpoint, entry.mdc_key)
+            old = self._kv_routers.pop(rk, None)
             if old is not None:
                 await old.stop()
-            self._kv_routers[entry.name] = kv_router
+            self._kv_routers[rk] = kv_router
         return build_pipeline_engine(mdc, core)

@@ -88,6 +88,11 @@ class Context:
         self._slots.pop().set_result(stream)
 
     def fail(self, exc: BaseException) -> None:
+        """Fail the nearest waiting node above. With no waiter left the
+        error has nowhere to flow — re-raise so it surfaces instead of
+        vanishing."""
+        if not self._slots:
+            raise exc
         self._slots.pop().set_exception(exc)
 
 
@@ -114,10 +119,18 @@ class Source:
         return sink
 
     async def on_next(self, ctx: Context) -> None:
+        """Forward to the edge. Invariant: ``on_data`` resolves or fails
+        the top slot exactly once and never raises — each node guards
+        its own synchronous work; this catch is the safety net that
+        turns an escaped node bug into a failed request, not a caller
+        hung forever on a leaked slot."""
         if self._edge is None:
             ctx.fail(RuntimeError(f"{type(self).__name__} has no edge"))
             return
-        await self._edge.on_data(ctx)
+        try:
+            await self._edge.on_data(ctx)
+        except BaseException as e:  # escaped on_data bug (see invariant)
+            ctx.fail(e)
 
 
 class _FrontendBase(Source):
@@ -267,7 +280,11 @@ class PipelineNode(Source, Sink):
 
     async def on_data(self, ctx: Context) -> None:
         if self._forward is not None:
-            ctx.map(self._forward)
+            try:
+                ctx.map(self._forward)
+            except BaseException as e:  # fail OUR waiter, don't unwind
+                ctx.fail(e)
+                return
         if self._backward is None:
             await self.on_next(ctx)
             return

@@ -89,12 +89,18 @@ class Supervisor:
     watchers via the circus control socket).
     """
 
-    def __init__(self, target: str, graph, config, allocator, endpoint: str):
+    def __init__(
+        self, target: str, graph, config, allocator, endpoint: str,
+        multihost_argv: list[str] | None = None,
+    ):
         self.target = target
         self.specs = {s.name: s for s in graph}
         self.config = config
         self.allocator = allocator
         self.endpoint = endpoint
+        # Extra serve_service flags for the (single) TPU service when
+        # this pod is one host of a multi-host slice.
+        self.multihost_argv = multihost_argv
         self.watchers: dict[str, list[Watcher]] = {s.name: [] for s in graph}
         self._next_idx = {s.name: 0 for s in graph}
         self._tasks: dict[Watcher, asyncio.Task] = {}
@@ -118,6 +124,8 @@ class Supervisor:
             "--service-name",
             spec.name,
         ]
+        if self.multihost_argv and int(spec.resources.get("tpu", 0)) > 0:
+            argv += self.multihost_argv
         idx = self._next_idx[spec.name]
         self._next_idx[spec.name] += 1
         return Watcher(spec, idx, argv, env)
@@ -240,7 +248,31 @@ async def serve_graph(args) -> None:
 
     config = ServiceConfig.load(args.config)
     allocator = TPUAllocator(args.tpu_chips)
-    sup = Supervisor(args.target, graph, config, allocator, endpoint)
+    multihost_argv: list[str] | None = None
+    if getattr(args, "num_nodes", 1) > 1:
+        # Multi-host slice: jax.distributed must be joined by the WORKER
+        # process that owns the TPU (one process per host), not by this
+        # supervisor — the flags are forwarded to the worker's
+        # serve_service argv (deploy tier renders one pod per host rank
+        # with these flags; reference capability: ray.rs:66-107).
+        tpu_specs = [s for s in graph if int(s.resources.get("tpu", 0)) > 0]
+        if len(tpu_specs) != 1 or tpu_specs[0].workers != 1:
+            raise SystemExit(
+                "--num-nodes > 1 needs --service-name selecting exactly one "
+                "TPU service with workers=1 (one joined process per host)"
+            )
+        multihost_argv = [
+            "--num-nodes", str(args.num_nodes),
+            "--node-rank", str(args.node_rank),
+            "--deployment", getattr(args, "deployment", "default"),
+            "--dist-port", str(getattr(args, "dist_port", 9911)),
+        ]
+        if getattr(args, "dist_leader", ""):
+            multihost_argv += ["--dist-leader", args.dist_leader]
+    sup = Supervisor(
+        args.target, graph, config, allocator, endpoint,
+        multihost_argv=multihost_argv,
+    )
     drt = DistributedRuntime(
         config=RuntimeConfig(coordinator_endpoint=endpoint)
     )
@@ -273,6 +305,15 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--service-name", default=None, help="run one service only")
     p.add_argument("--tpu-chips", type=int, default=None,
                    help="host chip budget (default: env DYN_TPU_CHIPS or 4)")
+    p.add_argument("--num-nodes", type=int, default=1,
+                   help="hosts in this service's TPU slice (multi-host)")
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--dist-leader", default="",
+                   help="rank-0 host:port; empty = discover via coordinator")
+    p.add_argument("--dist-port", type=int, default=9911,
+                   help="port rank 0 binds for jax.distributed")
+    p.add_argument("--deployment", default="default",
+                   help="leader-key namespace for multi-host discovery")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
 
     loop = asyncio.new_event_loop()

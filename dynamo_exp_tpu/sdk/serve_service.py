@@ -30,7 +30,12 @@ def load_target(target: str) -> type:
     return getattr(mod, cls_name)
 
 
-async def run_service(target: str, service_name: str | None, config_path: str | None):
+async def run_service(
+    target: str,
+    service_name: str | None,
+    config_path: str | None,
+    multihost=None,
+):
     from ..runtime.component import DistributedRuntime
     from ..runtime.engine import AsyncEngineContext
     from ..runtime.annotated import Annotated
@@ -50,6 +55,13 @@ async def run_service(target: str, service_name: str | None, config_path: str | 
         )
 
     drt = DistributedRuntime.from_settings()
+    if multihost is not None and multihost.is_multi_node:
+        # This worker owns the TPU for its host rank: join the global
+        # JAX runtime before anything touches a device (supervisor
+        # forwards the flags; reference capability: ray.rs:66-107).
+        from ..parallel.multihost import bringup
+
+        await bringup(multihost, discovery=drt.discovery)
     component = drt.namespace(spec.namespace).component(spec.component_name)
     dynamo_context.update(
         runtime=drt,
@@ -110,11 +122,28 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("target", help="pkg.module:RootClass")
     p.add_argument("--service-name", default=None)
     p.add_argument("--config", default=None)
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--dist-leader", default="")
+    p.add_argument("--dist-port", type=int, default=9911)
+    p.add_argument("--deployment", default="default")
     args = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    multihost = None
+    if args.num_nodes > 1:
+        from ..parallel.multihost import MultiNodeConfig
+
+        multihost = MultiNodeConfig(
+            num_nodes=args.num_nodes,
+            node_rank=args.node_rank,
+            leader_addr=args.dist_leader or None,
+            dist_port=args.dist_port,
+            deployment=args.deployment,
+        )
 
     loop = asyncio.new_event_loop()
     task = loop.create_task(
-        run_service(args.target, args.service_name, args.config)
+        run_service(args.target, args.service_name, args.config, multihost)
     )
     for sig in (signal.SIGINT, signal.SIGTERM):
         with contextlib.suppress(NotImplementedError, ValueError):

@@ -117,3 +117,40 @@ async def test_type_scoped_registration_and_removal(tmp_path):
         assert manager.chat_engine("tiny") is not None
     finally:
         await watcher.close()
+
+
+async def test_per_type_entries_route_to_their_own_endpoints(tmp_path):
+    """Chat and completion entries for ONE name at DIFFERENT endpoints:
+    each surface's traffic must ride its own entry's chain, not the
+    first-registered one."""
+    model_dir = build_tiny_model_dir(str(tmp_path / "m"))
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    w_chat = DistributedRuntime(discovery=disc, request_plane=plane)
+    w_comp = DistributedRuntime(discovery=disc, request_plane=plane)
+    ingress = DistributedRuntime(discovery=disc, request_plane=plane)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(ingress, manager)
+    await watcher.start()
+    try:
+        # Distinct components = distinct endpoints.
+        ep1 = w_chat.namespace("t").component("chatw").endpoint("generate")
+        ep2 = w_comp.namespace("t").component("compw").endpoint("generate")
+        await register_llm(w_chat, ep1, model_dir, "tiny", model_type="chat")
+        assert await _wait_for(lambda: manager.chat_engine("tiny") is not None)
+        await register_llm(w_comp, ep2, model_dir, "tiny", model_type="completion")
+        assert await _wait_for(
+            lambda: manager.completion_engine("tiny") is not None
+        )
+        # Different entries -> different chains (per serving identity).
+        assert manager.chat_engine("tiny") is not manager.completion_engine("tiny")
+
+        # The chat workers all dying must not tear down completion's
+        # (still live) chain.
+        lease = await w_chat.primary_lease()
+        await lease.revoke()
+        assert await _wait_for(lambda: manager.chat_engine("tiny") is None)
+        assert manager.completion_engine("tiny") is not None
+    finally:
+        await watcher.close()

@@ -191,3 +191,25 @@ async def test_build_segment_rejects_double_link():
     front.link(ServiceBackend(counting_engine()))
     with pytest.raises(RuntimeError, match="already linked"):
         front.link(ServiceBackend(counting_engine()))
+
+
+async def test_forward_map_exception_fails_request_not_hangs():
+    """A sync exception in a PipelineNode forward map under a
+    PipelineOperator must error the caller's request — not leak the
+    operator's slot and hang generate() forever."""
+
+    class PassThrough(Operator):
+        async def generate(self, request, next_engine, context):
+            return await next_engine.generate(request, context)
+
+    def bad_map(r):
+        raise KeyError("malformed request")
+
+    front = ServiceFrontend()
+    op = PipelineOperator(PassThrough())
+    front.link(op)
+    op.link(PipelineNode(forward=bad_map)).link(
+        ServiceBackend(counting_engine())
+    )
+    with pytest.raises(KeyError, match="malformed request"):
+        await asyncio.wait_for(front.generate({"n": 1}), timeout=2)
