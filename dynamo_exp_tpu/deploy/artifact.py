@@ -17,7 +17,6 @@ the same version — the api-store dedupes on it.
 from __future__ import annotations
 
 import hashlib
-import importlib
 import io
 import json
 import os
@@ -56,11 +55,11 @@ class ArtifactManifest:
 
 
 def _load_graph(graph_target: str):
-    mod_name, _, cls_name = graph_target.partition(":")
-    if not cls_name:
+    from ..sdk.serve_service import load_target
+
+    if ":" not in graph_target:
         raise ValueError(f"graph target must be module:Class, got {graph_target!r}")
-    mod = importlib.import_module(mod_name)
-    return getattr(mod, cls_name)
+    return load_target(graph_target)
 
 
 def _spec_dependencies(spec) -> list[str]:

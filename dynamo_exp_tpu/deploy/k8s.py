@@ -13,9 +13,12 @@ reconciled by a cluster operator:
 - a service requesting ``resources={"tpu": N}`` renders ``google.com/
   tpu: N`` limits plus GKE TPU node selectors
   (``cloud.google.com/gke-tpu-accelerator`` / ``gke-tpu-topology``);
-- multi-host TPU slices (``tpu_hosts > 1``) render as a headless
-  Service + one indexed Deployment per host rank carrying the
-  ``--num-nodes/--node-rank`` multihost flags.
+- multi-host TPU slices (``tpu_hosts > 1``) render as one Deployment
+  per host rank carrying the ``--num-nodes/--node-rank`` multihost
+  flags; rank 0 publishes its jax.distributed address in the
+  coordinator KV and followers discover it there
+  (``parallel/multihost.resolve_leader_addr``), so no headless Service
+  or stable pod DNS is needed.
 
 The output is ``kubectl apply``-ready YAML; no operator pod needed.
 """

@@ -92,24 +92,39 @@ class ModelWatcher:
         return out
 
     async def _apply(self, snapshot: dict[str, bytes]) -> None:
-        for key in list(self._active):
-            if key not in snapshot:
-                name, mtype = self._active.pop(key)
-                # N replicas write N keys for one model; drop each type
-                # only when the *last* entry providing it is gone.
-                still = self._covered_types(name)
-                gone = self._types_of(mtype) - still
-                if "chat" in gone:
-                    self.manager.remove_chat_model(name)
-                if "completion" in gone:
-                    self.manager.remove_completion_model(name)
-                if not still:
-                    for ck in [k for k in self._chains if k[0] == name]:
-                        del self._chains[ck]
-                    for rk in [k for k in self._kv_routers if k[0] == name]:
-                        router = self._kv_routers.pop(rk)
-                        await router.stop()  # drop its event sub + scrape loop
-                    logger.info("model %s removed (last worker gone)", name)
+        removed_keys = [k for k in self._active if k not in snapshot]
+        for key in removed_keys:
+            name, mtype = self._active.pop(key)
+            # N replicas write N keys for one model; drop each type
+            # only when the *last* entry providing it is gone.
+            still = self._covered_types(name)
+            gone = self._types_of(mtype) - still
+            if "chat" in gone:
+                self.manager.remove_chat_model(name)
+            if "completion" in gone:
+                self.manager.remove_completion_model(name)
+            if not still:
+                logger.info("model %s removed (last worker gone)", name)
+        if removed_keys:
+            # Chains/routers whose serving identity no longer has any
+            # live entry must stop — including when only ONE type of a
+            # name died and its identity differs from the survivor's
+            # (leaving it would scrape a dead endpoint forever).
+            live = set()
+            for k, (name, _) in self._active.items():
+                raw = snapshot.get(k)
+                if raw is None:
+                    continue
+                try:
+                    e = ModelEntry.from_bytes(raw)
+                except Exception:  # noqa: BLE001
+                    continue
+                live.add((e.name, e.endpoint, e.mdc_key))
+            for ck in [k for k in self._chains if k not in live]:
+                del self._chains[ck]
+            for rk in [k for k in self._kv_routers if k not in live]:
+                router = self._kv_routers.pop(rk)
+                await router.stop()  # drop its event sub + scrape loop
         for key, raw in snapshot.items():
             if key in self._active:
                 continue

@@ -1,0 +1,184 @@
+"""Native runtime tier: C++ extensions built on demand with the host
+toolchain, loaded via ctypes, each with an exact-mirror Python fallback.
+
+The reference's runtime hot paths are native (Rust tokens/codec, CUDA
+block movement — SURVEY.md §2.1/§2.2); here the TPU compute path is
+XLA/Pallas and the host-side hot paths go through this package. The
+fallback is not an approximation: it implements the same bit-exact
+algorithm, because hashes cross process boundaries (router vs worker)
+and both sides must agree regardless of which implementation ran.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_blockhash.so")
+_SRC = os.path.join(_HERE, "blockhash.cpp")
+
+_M = (1 << 64) - 1
+_GOLDEN = 0x9E3779B97F4A7C15
+_LOCAL_TAG = 0x00B10C4A54AA17E5
+_CHAIN_TAG = 0x00C4A18A54BB28F6
+_NO_PARENT_TAG = 0x006E6F5061726E74
+
+
+def _build() -> bool:
+    """Compile blockhash.cpp → _blockhash.so (atomic, race-safe: build
+    to a temp file and os.replace). Returns False when no compiler or
+    the package directory is read-only — callers fall back to Python."""
+    tmp_path = None
+    try:
+        with tempfile.NamedTemporaryFile(
+            dir=_HERE, suffix=".so", delete=False
+        ) as tmp:
+            tmp_path = tmp.name
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp_path, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp_path, _SO_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native blockhash build failed (%s); using Python", e)
+        if tmp_path is not None:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+        return False
+
+
+def _load():
+    if not os.path.exists(_SO_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:  # stale/foreign-arch .so — rebuild once
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_SO_PATH)
+    u64, u32p, i32 = ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32), ctypes.c_int
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.dx_block_hash.restype = u64
+    lib.dx_block_hash.argtypes = [u32p, u64, u64]
+    lib.dx_chain_hash.restype = u64
+    lib.dx_chain_hash.argtypes = [u64, i32, u64, u64]
+    lib.dx_seq_hashes.restype = u64
+    lib.dx_seq_hashes.argtypes = [u32p, u64, u64, u64, i32, u64, u64p]
+    return lib
+
+
+_lib = None
+_loaded = False
+
+
+def _get_lib():
+    """Lazy load: the (possibly g++-compiling) load happens on the first
+    hash call, not at import — a fleet of worker processes importing
+    tokens.py must not each stall on a synchronous compile at startup."""
+    global _lib, _loaded
+    if not _loaded:
+        _lib = _load()
+        _loaded = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _get_lib() is not None
+
+
+# ---------------------------------------------------------------- fallback
+def _mix64(x: int) -> int:
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & _M
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & _M
+    x ^= x >> 31
+    return x
+
+
+def _py_block_hash(tokens, seed: int) -> int:
+    h = _mix64((seed & _M) ^ _LOCAL_TAG)
+    for t in tokens:
+        h = _mix64(h ^ ((int(t) + _GOLDEN) & _M))
+    return _mix64(h ^ len(tokens))
+
+
+def _py_chain_hash(parent: int | None, local: int, seed: int) -> int:
+    h = _mix64((seed & _M) ^ _CHAIN_TAG)
+    h = _mix64(h ^ (_NO_PARENT_TAG if parent is None else parent & _M))
+    return _mix64(h ^ (local & _M))
+
+
+# --------------------------------------------------------------- public API
+def block_hash(tokens, seed: int) -> int:
+    _lib = _get_lib()
+    if _lib is None:
+        return _py_block_hash(tokens, seed)
+    import numpy as np
+
+    arr = np.ascontiguousarray(tokens, dtype=np.uint32)
+    return int(
+        _lib.dx_block_hash(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(arr),
+            seed & _M,
+        )
+    )
+
+
+def chain_hash(parent: int | None, local: int, seed: int) -> int:
+    _lib = _get_lib()
+    if _lib is None:
+        return _py_chain_hash(parent, local, seed)
+    return int(
+        _lib.dx_chain_hash(
+            0 if parent is None else parent & _M,
+            0 if parent is None else 1,
+            local & _M,
+            seed & _M,
+        )
+    )
+
+
+def seq_hashes(
+    tokens, block_size: int, seed: int, parent: int | None = None
+) -> list[int]:
+    """Sequence hashes of every complete block — one native call for the
+    whole prompt instead of a Python loop per block."""
+    _lib = _get_lib()
+    if _lib is None:
+        out: list[int] = []
+        p = parent
+        for start in range(0, len(tokens) - block_size + 1, block_size):
+            local = _py_block_hash(tokens[start : start + block_size], seed)
+            p = _py_chain_hash(p, local, seed)
+            out.append(p)
+        return out
+    import numpy as np
+
+    arr = np.ascontiguousarray(tokens, dtype=np.uint32)
+    nb = len(arr) // block_size
+    if nb == 0:
+        return []
+    out_arr = np.empty(nb, dtype=np.uint64)
+    n = _lib.dx_seq_hashes(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        len(arr),
+        block_size,
+        seed & _M,
+        0 if parent is None else 1,
+        0 if parent is None else parent & _M,
+        out_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return [int(h) for h in out_arr[:n]]

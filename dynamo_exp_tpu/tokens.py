@@ -15,7 +15,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Sequence
 
-import xxhash
+from . import native as _native
 
 # Salt seeds the first block's chain so that hashes from different
 # deployments/configurations don't collide by construction.
@@ -23,20 +23,15 @@ DEFAULT_HASH_SEED = 1337
 
 
 def compute_block_hash(tokens: Sequence[int], seed: int = DEFAULT_HASH_SEED) -> int:
-    """Hash one block's tokens (local hash, not chained)."""
-    h = xxhash.xxh3_64(seed=seed)
-    for t in tokens:
-        h.update(int(t).to_bytes(4, "little", signed=False))
-    return h.intdigest()
+    """Hash one block's tokens (local hash, not chained). Dispatches to
+    the C++ extension (``native/blockhash.cpp``) with a bit-exact Python
+    fallback."""
+    return _native.block_hash(tokens, seed)
 
 
 def chain_hash(parent: int | None, local: int, seed: int = DEFAULT_HASH_SEED) -> int:
     """Chain a block's local hash onto its prefix's sequence hash."""
-    h = xxhash.xxh3_64(seed=seed)
-    if parent is not None:
-        h.update(int(parent).to_bytes(8, "little", signed=False))
-    h.update(int(local).to_bytes(8, "little", signed=False))
-    return h.intdigest()
+    return _native.chain_hash(parent, local, seed)
 
 
 def compute_block_hashes_for_seq(
@@ -45,15 +40,10 @@ def compute_block_hashes_for_seq(
     """Sequence hashes for every *complete* block of ``tokens``.
 
     This is what the router hashes incoming requests with (reference:
-    ``lib/llm/src/kv_router/indexer.rs:123`` ``compute_block_hash_for_seq``).
+    ``lib/llm/src/kv_router/indexer.rs:123`` ``compute_block_hash_for_seq``)
+    — one native call over the whole prompt, not a Python loop per block.
     """
-    hashes: list[int] = []
-    parent: int | None = None
-    for start in range(0, len(tokens) - block_size + 1, block_size):
-        local = compute_block_hash(tokens[start : start + block_size], seed)
-        parent = chain_hash(parent, local, seed)
-        hashes.append(parent)
-    return hashes
+    return _native.seq_hashes(tokens, block_size, seed)
 
 
 @dataclass(frozen=True)

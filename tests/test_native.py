@@ -1,0 +1,70 @@
+"""Native tier: the C++ blockhash extension and its bit-exact Python
+mirror must agree — router and worker processes key prefix identity on
+these hashes, so the two implementations disagreeing would silently
+break cache reuse across processes."""
+
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu import native
+from dynamo_exp_tpu.tokens import (
+    compute_block_hash,
+    compute_block_hashes_for_seq,
+    chain_hash,
+)
+
+
+def test_extension_builds_and_loads():
+    # g++ is part of the image; the extension must actually build here.
+    assert native.native_available()
+
+
+def test_cpp_matches_python_mirror():
+    rs = np.random.RandomState(0)
+    for n in (1, 7, 16, 64, 300):
+        toks = rs.randint(0, 2**31, size=n).tolist()
+        for seed in (0, 1337, 2**63):
+            assert native.block_hash(toks, seed) == native._py_block_hash(
+                toks, seed
+            )
+    local = native.block_hash([1, 2, 3], 1337)
+    for parent in (None, 0, 1, 2**64 - 1, local):
+        assert native.chain_hash(parent, local, 1337) == native._py_chain_hash(
+            parent, local, 1337
+        )
+
+
+def test_batch_seq_hashes_match_blockwise_loop():
+    rs = np.random.RandomState(1)
+    toks = rs.randint(0, 2**31, size=67).tolist()  # 4 full blocks of 16 + tail
+    batch = native.seq_hashes(toks, 16, 1337)
+    loop = []
+    parent = None
+    for start in range(0, len(toks) - 15, 16):
+        local = compute_block_hash(toks[start : start + 16])
+        parent = chain_hash(parent, local)
+        loop.append(parent)
+    assert batch == loop == compute_block_hashes_for_seq(toks, 16)
+    assert len(batch) == 4
+
+
+def test_hash_properties():
+    # Equal prefixes -> equal sequence hashes; diverging block -> different.
+    a = list(range(64))
+    b = list(range(48)) + [999] * 16
+    ha = compute_block_hashes_for_seq(a, 16)
+    hb = compute_block_hashes_for_seq(b, 16)
+    assert ha[:3] == hb[:3]
+    assert ha[3] != hb[3]
+    # Parent participates: same block content, different prefix.
+    assert chain_hash(ha[0], 42) != chain_hash(hb[3], 42)
+    assert chain_hash(None, 42) != chain_hash(0, 42)  # None is not 0
+    # Seed participates.
+    assert compute_block_hash([1, 2, 3], 1) != compute_block_hash([1, 2, 3], 2)
+    # Length participates (trailing content vs shorter block).
+    assert compute_block_hash([1, 2]) != compute_block_hash([1, 2, 0])
+
+
+def test_incomplete_block_yields_nothing():
+    assert compute_block_hashes_for_seq([1, 2, 3], 16) == []
+    assert native.seq_hashes([], 16, 1337) == []
