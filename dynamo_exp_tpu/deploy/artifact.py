@@ -57,9 +57,12 @@ class ArtifactManifest:
 def _load_graph(graph_target: str):
     from ..sdk.serve_service import load_target
 
-    if ":" not in graph_target:
-        raise ValueError(f"graph target must be module:Class, got {graph_target!r}")
-    return load_target(graph_target)
+    try:
+        return load_target(graph_target)
+    except SystemExit as e:  # CLI helper — re-raise as a library error
+        raise ValueError(
+            f"graph target must be module:Class, got {graph_target!r}"
+        ) from e
 
 
 def _spec_dependencies(spec) -> list[str]:

@@ -35,16 +35,15 @@ class ModelWatcher:
         self.drt = drt
         self.manager = manager
         self.router_mode = router_mode
-        # kv key -> (model name, model_type): registrations are
-        # type-scoped (a name can be chat-only, completion-only, or
-        # both via separate entries — e.g. llmctl's per-type keys).
-        self._active: dict[str, tuple[str, str]] = {}
         self._task: asyncio.Task | None = None
-        # Chains/routers are keyed by the serving identity — (name,
-        # endpoint, mdc_key) — NOT by name alone: one name's chat and
-        # completion entries may point at different endpoints (different
-        # workers), and each type's traffic must ride its own entry's
+        # Reconciled state. Bindings map each served surface —
+        # (name, "chat"/"completion") — to the serving identity it is
+        # currently routed through. Chains/routers are keyed by that
+        # identity — (name, endpoint, mdc_key) — NOT by name alone: one
+        # name's chat and completion entries may point at different
+        # workers, and each surface's traffic must ride its own entry's
         # chain.
+        self._bindings: dict[tuple[str, str], tuple] = {}
         self._kv_routers: dict[tuple, object] = {}
         self._chains: dict[tuple, object] = {}
 
@@ -83,80 +82,68 @@ class ModelWatcher:
     def _types_of(model_type: str) -> set[str]:
         return {"chat", "completion"} if model_type == "both" else {model_type}
 
-    def _covered_types(self, name: str) -> set[str]:
-        """Types currently provided for ``name`` by active entries."""
-        out: set[str] = set()
-        for n, t in self._active.values():
-            if n == name:
-                out |= self._types_of(t)
-        return out
-
     async def _apply(self, snapshot: dict[str, bytes]) -> None:
-        removed_keys = [k for k in self._active if k not in snapshot]
-        for key in removed_keys:
-            name, mtype = self._active.pop(key)
-            # N replicas write N keys for one model; drop each type
-            # only when the *last* entry providing it is gone.
-            still = self._covered_types(name)
-            gone = self._types_of(mtype) - still
-            if "chat" in gone:
-                self.manager.remove_chat_model(name)
-            if "completion" in gone:
-                self.manager.remove_completion_model(name)
-            if not still:
-                logger.info("model %s removed (last worker gone)", name)
-        if removed_keys:
-            # Chains/routers whose serving identity no longer has any
-            # live entry must stop — including when only ONE type of a
-            # name died and its identity differs from the survivor's
-            # (leaving it would scrape a dead endpoint forever).
-            live = set()
-            for k, (name, _) in self._active.items():
-                raw = snapshot.get(k)
-                if raw is None:
-                    continue
-                try:
-                    e = ModelEntry.from_bytes(raw)
-                except Exception:  # noqa: BLE001
-                    continue
-                live.add((e.name, e.endpoint, e.mdc_key))
-            for ck in [k for k in self._chains if k not in live]:
-                del self._chains[ck]
-            for rk in [k for k in self._kv_routers if k not in live]:
-                router = self._kv_routers.pop(rk)
-                await router.stop()  # drop its event sub + scrape loop
-        for key, raw in snapshot.items():
-            if key in self._active:
-                continue
-            # Per-entry guard: one bad entry (missing MDC, unreadable
-            # tokenizer path) must not block its siblings.
+        """Reconcile served surfaces with the snapshot, declaratively.
+
+        Desired state is recomputed from scratch each time: for every
+        (name, type) surface, the first live entry (sorted by KV key,
+        deterministic) provides the serving identity. Diffing desired
+        against current bindings handles every transition in one place
+        — add, last-replica removal, AND identity churn (a worker
+        re-registering with a new endpoint or model card rebinds the
+        surface to the new identity instead of freezing on the old).
+        """
+        desired: dict[tuple[str, str], tuple] = {}
+        entries_by_identity: dict[tuple, ModelEntry] = {}
+        for key in sorted(snapshot):
             try:
-                entry = ModelEntry.from_bytes(raw)
-                new_types = self._types_of(entry.model_type) - self._covered_types(
-                    entry.name
-                )
-                if new_types:
-                    # First entry for this (name, type): build — or
-                    # reuse — the chain for this entry's serving
-                    # identity. The chain's client watches every live
-                    # instance of the endpoint, so later replicas of
-                    # the same endpoint ride it too.
-                    ck = (entry.name, entry.endpoint, entry.mdc_key)
-                    engine = self._chains.get(ck)
-                    if engine is None:
-                        engine = await self._build_chain(entry)
-                        self._chains[ck] = engine
-                    if "chat" in new_types:
-                        self.manager.add_chat_model(entry.name, engine)
-                    if "completion" in new_types:
-                        self.manager.add_completion_model(entry.name, engine)
-                    logger.info(
-                        "model %s (%s) registered via %s",
-                        entry.name, entry.model_type, entry.endpoint,
-                    )
-                self._active[key] = (entry.name, entry.model_type)
+                entry = ModelEntry.from_bytes(snapshot[key])
+            except Exception:  # noqa: BLE001 - one bad entry: skip it
+                logger.exception("undecodable model entry %s", key)
+                continue
+            ident = (entry.name, entry.endpoint, entry.mdc_key)
+            entries_by_identity.setdefault(ident, entry)
+            for t in self._types_of(entry.model_type):
+                desired.setdefault((entry.name, t), ident)
+
+        # Bind new/changed surfaces. Per-surface guard: one bad entry
+        # (missing MDC, unreadable tokenizer) must not block siblings.
+        for surface, ident in desired.items():
+            if self._bindings.get(surface) == ident:
+                continue
+            try:
+                engine = self._chains.get(ident)
+                if engine is None:
+                    engine = await self._build_chain(entries_by_identity[ident])
+                    self._chains[ident] = engine
+                name, t = surface
+                if t == "chat":
+                    self.manager.add_chat_model(name, engine)
+                else:
+                    self.manager.add_completion_model(name, engine)
+                self._bindings[surface] = ident
+                logger.info("model %s (%s) bound to %s", name, t, ident[1])
             except Exception:  # noqa: BLE001 - retried on next KV change
-                logger.exception("failed to register model entry %s", key)
+                logger.exception("failed to bind %s to %s", surface, ident)
+
+        # Unbind surfaces with no live entry left.
+        for surface in [s for s in self._bindings if s not in desired]:
+            name, t = surface
+            if t == "chat":
+                self.manager.remove_chat_model(name)
+            else:
+                self.manager.remove_completion_model(name)
+            del self._bindings[surface]
+            logger.info("model %s (%s) removed (last worker gone)", name, t)
+
+        # Tear down chains/routers no surface routes through anymore
+        # (identity died, or a rebind moved its surfaces elsewhere).
+        in_use = set(self._bindings.values())
+        for ck in [k for k in self._chains if k not in in_use]:
+            del self._chains[ck]
+        for rk in [k for k in self._kv_routers if k not in in_use]:
+            router = self._kv_routers.pop(rk)
+            await router.stop()  # drop its event sub + scrape loop
 
     async def _build_chain(self, entry: ModelEntry):
         raw = await self.drt.object_store.get(MDC_BUCKET, entry.mdc_key)

@@ -92,10 +92,14 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     return params
 
 
-def param_shardings(cfg: ModelConfig, tp_axis: str = "tp") -> Params:
+def param_shardings(
+    cfg: ModelConfig, tp_axis: str = "tp", ep_axis: str | None = None
+) -> Params:
     """PartitionSpec pytree matching ``init_params``: megatron-style TP —
     QKV/gate/up column-sharded over heads/ffn, O/down row-sharded, embed
-    and lm_head vocab-sharded."""
+    and lm_head vocab-sharded. With ``ep_axis``, MoE expert weights
+    additionally shard their expert dim over it (the ``moe_ffn_ep``
+    layout)."""
     layers: Params = {
         "attn_norm": P(None, None),
         "wq": P(None, None, tp_axis),
@@ -109,13 +113,14 @@ def param_shardings(cfg: ModelConfig, tp_axis: str = "tp") -> Params:
         layers["bk"] = P(None, tp_axis)
         layers["bv"] = P(None, tp_axis)
     if cfg.is_moe:
-        # Replicated router; every expert's FFN tp-sharded on the ffn dim
-        # (same layout as the dense path, so MoE composes with the
-        # existing GSPMD collectives regardless of routing skew).
+        # Replicated router; every expert's FFN tp-sharded on the ffn
+        # dim (same layout as the dense path, so MoE composes with the
+        # existing GSPMD collectives regardless of routing skew). With
+        # an ep axis the expert dim shards too (moe_ffn_ep shard_map).
         layers["router"] = P(None, None, None)
-        layers["w_gate"] = P(None, None, None, tp_axis)
-        layers["w_up"] = P(None, None, None, tp_axis)
-        layers["w_down"] = P(None, None, tp_axis, None)
+        layers["w_gate"] = P(None, ep_axis, None, tp_axis)
+        layers["w_up"] = P(None, ep_axis, None, tp_axis)
+        layers["w_down"] = P(None, ep_axis, tp_axis, None)
     else:
         layers["w_gate"] = P(None, None, tp_axis)
         layers["w_up"] = P(None, None, tp_axis)
@@ -164,7 +169,9 @@ def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
     return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend, reduce=None):
+def _attn_mlp_layer(
+    x, lp, cfg, inv_freq, rope_pos, eps, attend, reduce=None, mesh=None
+):
     """One transformer layer, shared by the paged and ring paths.
 
     ``attend(q, k, v) -> (attn_out, kv_extra)`` is the only thing that
@@ -197,18 +204,28 @@ def _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend, reduce=None):
     x = x + red(attn.reshape(B, T, -1) @ lp["wo"])
     h = rms_norm(x, lp["mlp_norm"], eps)
     if "router" in lp:
-        from ..ops.moe import moe_ffn
+        from ..ops.moe import moe_ffn, moe_ffn_ep
 
-        y = moe_ffn(
-            h.reshape(B * T, -1),
-            lp["router"],
-            lp["w_gate"],
-            lp["w_up"],
-            lp["w_down"],
-            cfg.num_experts_per_tok,
-            cfg.norm_topk_prob,
-        ).reshape(B, T, -1)
-        x = x + red(y)
+        if mesh is not None and mesh.shape.get("ep", 1) > 1:
+            # Experts sharded over the mesh's ep axis (shard_map path);
+            # the psum inside covers both ep and tp, so no outer reduce.
+            y = moe_ffn_ep(
+                h.reshape(B * T, -1),
+                lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+                cfg.num_experts_per_tok, cfg.norm_topk_prob, mesh,
+            ).reshape(B, T, -1)
+            x = x + y
+        else:
+            y = moe_ffn(
+                h.reshape(B * T, -1),
+                lp["router"],
+                lp["w_gate"],
+                lp["w_up"],
+                lp["w_down"],
+                cfg.num_experts_per_tok,
+                cfg.norm_topk_prob,
+            ).reshape(B, T, -1)
+            x = x + red(y)
     else:
         gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
         x = x + red((gate * (h @ lp["w_up"])) @ lp["w_down"])
@@ -326,7 +343,9 @@ def forward(
                 (kp, vp),
             )
 
-        return _attn_mlp_layer(x, lp, cfg, inv_freq, rope_pos, eps, attend)
+        return _attn_mlp_layer(
+            x, lp, cfg, inv_freq, rope_pos, eps, attend, mesh=mesh
+        )
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], k_cache, v_cache)

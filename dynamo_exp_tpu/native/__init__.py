@@ -58,8 +58,18 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """The built .so predates the source — rebuild, or a process with
+    the old binary would hash differently from freshly built peers
+    (the 'change both or neither' contract in blockhash.cpp)."""
+    try:
+        return os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC)
+    except OSError:
+        return True
+
+
 def _load():
-    if not os.path.exists(_SO_PATH) and not _build():
+    if (not os.path.exists(_SO_PATH) or _stale()) and not _build():
         return None
     try:
         lib = ctypes.CDLL(_SO_PATH)

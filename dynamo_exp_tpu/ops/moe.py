@@ -88,6 +88,65 @@ def moe_ffn(
     return y.astype(x.dtype)
 
 
+def moe_ffn_ep(
+    x: jnp.ndarray,  # [N, D] (replicated across the moe axes)
+    router_w: jnp.ndarray,  # [D, E] replicated
+    w_gate: jnp.ndarray,  # [E, D, I] sharded P(ep, None, tp)
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,  # [E, I, D] sharded P(ep, tp, None)
+    num_experts_per_tok: int,
+    norm_topk_prob: bool,
+    mesh,
+    ep_axis: str = "ep",
+    tp_axis: str = "tp",
+) -> jnp.ndarray:
+    """Expert-parallel MoE FFN: experts sharded over the mesh's ``ep``
+    axis (composing with ``tp`` inside each expert), tokens replicated.
+
+    Every rank densely computes its E/ep local experts for all tokens
+    masked by the combine weights, then a psum over (ep, tp) sums the
+    expert contributions and the ffn partials. Inference-shaped N makes
+    the E_local× overcompute cheap relative to moving tokens between
+    ranks (the training-style all-to-all dispatch), and no routing skew
+    can idle a rank. SURVEY.md §2.10: "Expert parallel / MoE → mesh
+    ``expert`` axis in JAX engine".
+    """
+    from functools import partial as _partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    N, D = x.shape
+    E = router_w.shape[-1]
+    weights, ids = moe_router(x, router_w, num_experts_per_tok, norm_topk_prob)
+    combine = jnp.zeros((N, E), jnp.float32)
+    combine = combine.at[jnp.arange(N)[:, None], ids].add(weights)
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P(None, ep_axis),
+            P(ep_axis, None, tp_axis),
+            P(ep_axis, None, tp_axis),
+            P(ep_axis, tp_axis, None),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def f(x_l, comb_l, wg, wu, wd):
+        g = jnp.einsum("nd,edi->eni", x_l, wg)
+        u = jnp.einsum("nd,edi->eni", x_l, wu)
+        h = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
+        o = jnp.einsum("eni,eid->end", h, wd).astype(jnp.float32)
+        y = jnp.einsum("ne,end->nd", comb_l, o)
+        # Sum expert contributions (ep) and ffn partials (tp) together.
+        return jax.lax.psum(y, (ep_axis, tp_axis))
+
+    return f(x, combine, w_gate, w_up, w_down).astype(x.dtype)
+
+
 def moe_ffn_reference(
     x, router_w, w_gate, w_up, w_down, num_experts_per_tok,
     norm_topk_prob=True,

@@ -154,3 +154,36 @@ async def test_per_type_entries_route_to_their_own_endpoints(tmp_path):
         assert manager.completion_engine("tiny") is not None
     finally:
         await watcher.close()
+
+
+async def test_rebind_on_identity_churn(tmp_path):
+    """A worker replaced by one at a different endpoint (same name and
+    type) must rebind the surface to the new identity — not freeze on
+    the dead chain."""
+    model_dir = build_tiny_model_dir(str(tmp_path / "m"))
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    w_old = DistributedRuntime(discovery=disc, request_plane=plane)
+    w_new = DistributedRuntime(discovery=disc, request_plane=plane)
+    ingress = DistributedRuntime(discovery=disc, request_plane=plane)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(ingress, manager)
+    await watcher.start()
+    try:
+        ep_old = w_old.namespace("t").component("oldw").endpoint("generate")
+        await register_llm(w_old, ep_old, model_dir, "tiny", model_type="chat")
+        assert await _wait_for(lambda: manager.chat_engine("tiny") is not None)
+        first = manager.chat_engine("tiny")
+
+        ep_new = w_new.namespace("t").component("neww").endpoint("generate")
+        await register_llm(w_new, ep_new, model_dir, "tiny", model_type="chat")
+        lease = await w_old.primary_lease()
+        await lease.revoke()  # old worker dies; new one stays
+
+        assert await _wait_for(
+            lambda: manager.chat_engine("tiny") is not None
+            and manager.chat_engine("tiny") is not first
+        )
+    finally:
+        await watcher.close()

@@ -302,3 +302,36 @@ def test_hf_parity_mixtral(tmp_path, _hf_env):
     # Slightly looser: expert-sum accumulation order differs between
     # ragged_dot grouping and HF's per-expert index_add.
     _parity_check(tmp_path, transformers.MixtralForCausalLM(c), c, atol=5e-3)
+
+
+def test_moe_expert_parallel_matches_single_device():
+    """Experts sharded over the mesh's ep axis (moe_ffn_ep shard_map
+    path) must match the single-device forward — alone and composed
+    with tp (SURVEY §2.10 'mesh expert axis')."""
+    from dynamo_exp_tpu.parallel import build_mesh, shard_pytree
+
+    cfg = TINY_MOE
+    params = _f32_params(cfg, 17)
+    toks = list(np.random.RandomState(8).randint(1, cfg.vocab_size, size=10))
+    want = _full_logits(params, cfg, toks)
+
+    for ep, tp in ((2, 1), (2, 2)):
+        mesh = build_mesh(tp=tp, ep=ep)
+        sp, _ = shard_pytree(
+            mesh, params, param_shardings(cfg, ep_axis="ep")
+        )
+        fwd = jax.jit(forward, static_argnums=(1,), static_argnames=("mesh",))
+        T = len(toks)
+        pmax = (T + PS - 1) // PS
+        k, v = init_kv_cache(cfg, num_pages=pmax + 1, page_size=PS, dtype=jnp.float32)
+        table = jnp.arange(pmax, dtype=jnp.int32)[None, :] + 1
+        logits, _, _ = fwd(
+            sp, cfg,
+            jnp.array([toks], jnp.int32),
+            jnp.arange(T, dtype=jnp.int32)[None, :], table, k, v,
+            mesh=mesh,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[0]), want, rtol=1e-3, atol=1e-3,
+            err_msg=f"ep={ep} tp={tp}",
+        )
