@@ -188,11 +188,16 @@ def config_from_gguf(g: GGUFFile):
         vocab = len(tokens) if tokens else 32000
     heads = md[f"{a}.attention.head_count"]
     emb = md[f"{a}.embedding_length"]
+    # Mixtral-style MoE ships under the llama arch with expert_count
+    # metadata and stacked ..._exps tensors.
+    n_experts = int(md.get(f"{a}.expert_count", 0) or 0)
     return ModelConfig(
         vocab_size=int(vocab),
         hidden_size=int(emb),
         intermediate_size=int(md[f"{a}.feed_forward_length"]),
         num_layers=int(md[f"{a}.block_count"]),
+        num_experts=n_experts,
+        num_experts_per_tok=int(md.get(f"{a}.expert_used_count", 2) or 2),
         num_heads=int(heads),
         num_kv_heads=int(md.get(f"{a}.attention.head_count_kv", heads)),
         head_dim=int(md[f"{a}.rope.dimension_count"])
@@ -242,7 +247,10 @@ def load_params_from_gguf(path: str, cfg=None):
     # llama.cpp's converter permutes q/k weights ONLY for the llama
     # architecture (qwen2 uses NEOX-style rope and stores them as-is);
     # unpermuting unconditionally would scramble qwen2 head halves.
-    permuted = cfg.model_type == "llama"
+    # Keyed on the FILE's arch, not cfg.model_type: mixtral ships under
+    # the llama arch (permuted) even though its ModelConfig says
+    # mixtral.
+    permuted = g.metadata.get("general.architecture", "llama") == "llama"
 
     def qk(name: str, heads: int) -> np.ndarray:
         w = g.tensor(name)
@@ -252,6 +260,8 @@ def load_params_from_gguf(path: str, cfg=None):
             "w_gate", "w_up", "w_down"]
     if cfg.attention_bias:
         keys += ["bq", "bk", "bv"]
+    if cfg.is_moe:
+        keys.append("router")
     layers: dict[str, list] = {k: [] for k in keys}
     for i in range(cfg.num_layers):
         p = f"blk.{i}."
@@ -265,9 +275,24 @@ def load_params_from_gguf(path: str, cfg=None):
             layers["bq"].append(g.tensor(p + "attn_q.bias"))
             layers["bk"].append(g.tensor(p + "attn_k.bias"))
             layers["bv"].append(g.tensor(p + "attn_v.bias"))
-        layers["w_gate"].append(linear(p + "ffn_gate.weight"))
-        layers["w_up"].append(linear(p + "ffn_up.weight"))
-        layers["w_down"].append(linear(p + "ffn_down.weight"))
+        if cfg.is_moe:
+            # llama.cpp stacks experts in one 3-D tensor per proj:
+            # ffn_gate_exps [E, I, D] / ffn_down_exps [E, D, I] (numpy
+            # shape = reversed ne); ours are x@W → swap the last two.
+            layers["router"].append(linear(p + "ffn_gate_inp.weight"))
+            layers["w_gate"].append(
+                g.tensor(p + "ffn_gate_exps.weight").swapaxes(1, 2)
+            )
+            layers["w_up"].append(
+                g.tensor(p + "ffn_up_exps.weight").swapaxes(1, 2)
+            )
+            layers["w_down"].append(
+                g.tensor(p + "ffn_down_exps.weight").swapaxes(1, 2)
+            )
+        else:
+            layers["w_gate"].append(linear(p + "ffn_gate.weight"))
+            layers["w_up"].append(linear(p + "ffn_up.weight"))
+            layers["w_down"].append(linear(p + "ffn_down.weight"))
 
     params = {
         "embed": jnp.asarray(g.tensor("token_embd.weight"), dt),

@@ -207,3 +207,74 @@ def test_gguf_rejects_unknown_quant(tmp_path):
     path.write_bytes(bytes(head))
     with pytest.raises(ValueError, match="unsupported GGUF encoding"):
         GGUFFile.parse(str(path)).tensor("w")
+
+
+def test_gguf_moe_roundtrip(tmp_path):
+    """Mixtral-style GGUF (llama arch + expert_count + stacked _exps
+    tensors) loads to bit-identical logits vs the source MoE params."""
+    import dataclasses
+
+    from dynamo_exp_tpu.models import TINY_MOE
+
+    cfg = dataclasses.replace(TINY_MOE, dtype="float32")
+    params = init_params(jax.random.PRNGKey(5), cfg)
+    hd = cfg.head_dim_
+
+    def permute(w_hf, heads):
+        out, inner = w_hf.shape
+        return (
+            w_hf.reshape(heads, 2, hd // 2, inner).swapaxes(1, 2)
+            .reshape(out, inner)
+        )
+
+    f32 = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    lp = params["layers"]
+    tensors = {"token_embd.weight": f32(params["embed"])}
+    for i in range(cfg.num_layers):
+        p = f"blk.{i}."
+        tensors[p + "attn_norm.weight"] = f32(lp["attn_norm"][i])
+        tensors[p + "attn_q.weight"] = permute(f32(lp["wq"][i]).T, cfg.num_heads)
+        tensors[p + "attn_k.weight"] = permute(f32(lp["wk"][i]).T, cfg.num_kv_heads)
+        tensors[p + "attn_v.weight"] = f32(lp["wv"][i]).T
+        tensors[p + "attn_output.weight"] = f32(lp["wo"][i]).T
+        tensors[p + "ffn_norm.weight"] = f32(lp["mlp_norm"][i])
+        tensors[p + "ffn_gate_inp.weight"] = f32(lp["router"][i]).T
+        # llama.cpp layout: [E, I, D] for gate/up, [E, D, I] for down.
+        tensors[p + "ffn_gate_exps.weight"] = f32(lp["w_gate"][i]).swapaxes(1, 2)
+        tensors[p + "ffn_up_exps.weight"] = f32(lp["w_up"][i]).swapaxes(1, 2)
+        tensors[p + "ffn_down_exps.weight"] = f32(lp["w_down"][i]).swapaxes(1, 2)
+    tensors["output_norm.weight"] = f32(params["final_norm"])
+    if "lm_head" in params:
+        tensors["output.weight"] = f32(params["lm_head"]).T
+    write_gguf(
+        str(tmp_path / "moe.gguf"),
+        {
+            "general.architecture": "llama",
+            "llama.embedding_length": cfg.hidden_size,
+            "llama.block_count": cfg.num_layers,
+            "llama.attention.head_count": cfg.num_heads,
+            "llama.attention.head_count_kv": cfg.num_kv_heads,
+            "llama.feed_forward_length": cfg.intermediate_size,
+            "llama.rope.dimension_count": hd,
+            "llama.expert_count": cfg.num_experts,
+            "llama.expert_used_count": cfg.num_experts_per_tok,
+            "llama.vocab_size": cfg.vocab_size,
+        },
+        tensors,
+    )
+
+    got_cfg = config_from_gguf(GGUFFile.parse(str(tmp_path / "moe.gguf")))
+    assert got_cfg.num_experts == cfg.num_experts
+    assert got_cfg.num_experts_per_tok == cfg.num_experts_per_tok
+
+    loaded, _ = load_params_from_gguf(str(tmp_path / "moe.gguf"), cfg)
+    toks = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+    pos = jnp.arange(4, dtype=jnp.int32)[None]
+    table = jnp.asarray([[1]], jnp.int32)
+
+    def logits(p):
+        k, v = init_kv_cache(cfg, num_pages=4, page_size=8, dtype=jnp.float32)
+        out, _, _ = forward(p, cfg, toks, pos, table, k, v)
+        return np.asarray(out)
+
+    np.testing.assert_array_equal(logits(loaded), logits(params))
