@@ -69,6 +69,9 @@ def parse_args(argv: list[str]):
     p.add_argument("--request-template", default="",
                    help="JSON file of request defaults (model/temperature/"
                         "max_completion_tokens), reference request_template.rs")
+    p.add_argument("--profiler-port", type=int, default=0,
+                   help="expose the jax.profiler gRPC server on this port "
+                        "(attach with tensorboard/xprof); 0 = off")
     # Multi-host engine (reference: MultiNodeConfig, engines.rs:41-50 +
     # ray.rs leader/follower join): every node runs this CLI with the
     # same flags plus its own --node-rank; rank 0 is the leader.
@@ -435,6 +438,11 @@ async def main_async(opts) -> None:
 
     from .runtime.component import DistributedRuntime
     from .runtime.config import RuntimeConfig
+
+    if opts.profiler_port:
+        from .runtime.profiler import start_profiler_server
+
+        start_profiler_server(opts.profiler_port)
 
     needs_cluster = opts.input.startswith("dyn://") or opts.output.startswith("dyn://")
     if needs_cluster and not opts.coordinator:

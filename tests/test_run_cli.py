@@ -167,3 +167,19 @@ async def test_three_process_serve_with_discovery(tmp_path):
             worker.kill()
             worker.wait(timeout=10)
         await server.close()
+
+
+def test_profiler_trace_capture(tmp_path):
+    """trace_to produces a profile artifact directory (CPU backend)."""
+    import os
+
+    from dynamo_exp_tpu.runtime.profiler import trace_to
+
+    import jax.numpy as jnp
+
+    with trace_to(str(tmp_path)):
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).block_until_ready()
+    found = []
+    for root, _dirs, files in os.walk(tmp_path):
+        found += [f for f in files if f.endswith((".pb", ".json.gz", ".trace"))]
+    assert found, "no profiler artifacts written"
