@@ -38,6 +38,8 @@ class ModelConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     attention_bias: bool = False
+    # qwen3: per-head RMSNorm on q and k after projection, before rope.
+    qk_norm: bool = False
     # Mistral: keys older than (q_pos - sliding_window + 1) are masked.
     # None = full causal attention.
     sliding_window: int | None = None
@@ -85,6 +87,7 @@ class ModelConfig:
             attention_bias=cfg.get(
                 "attention_bias", model_type in ("qwen2", "qwen2_moe")
             ),
+            qk_norm=model_type in ("qwen3", "qwen3_moe"),
             # qwen2 ships a sliding_window value with
             # use_sliding_window=false — honour the switch, or every
             # HF-loaded qwen2 would lose the Pallas decode path and
@@ -184,6 +187,35 @@ TINY_MOE = ModelConfig(  # mixtral family shape: 4 experts, top-2 routing
     model_type="mixtral",
 )
 
+TINY_QWEN3 = ModelConfig(  # qwen3 family shape: q/k norm, no bias
+    vocab_size=256,
+    hidden_size=64,
+    intermediate_size=128,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    max_position_embeddings=512,
+    qk_norm=True,
+    tie_word_embeddings=True,
+    rms_norm_eps=1e-6,
+    model_type="qwen3",
+)
+
+QWEN3_8B = ModelConfig(  # Qwen3-8B shape
+    vocab_size=151936,
+    hidden_size=4096,
+    intermediate_size=12288,
+    num_layers=36,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    rope_theta=1000000.0,
+    max_position_embeddings=40960,
+    qk_norm=True,
+    rms_norm_eps=1e-6,
+    model_type="qwen3",
+)
+
 QWEN2_7B = ModelConfig(  # Qwen2-7B-Instruct shape
     vocab_size=152064,
     hidden_size=3584,
@@ -227,11 +259,13 @@ MIXTRAL_8X7B = ModelConfig(  # Mixtral-8x7B shape
 PRESETS = {
     "tiny": TINY,
     "tiny-qwen2": TINY_QWEN2,
+    "tiny-qwen3": TINY_QWEN3,
     "tiny-moe": TINY_MOE,
     "llama-1b": LLAMA_1B,
     "llama-3b": LLAMA_3B,
     "llama-8b": LLAMA_8B,
     "qwen2-7b": QWEN2_7B,
+    "qwen3-8b": QWEN3_8B,
     "mistral-7b": MISTRAL_7B,
     "mixtral-8x7b": MIXTRAL_8X7B,
 }

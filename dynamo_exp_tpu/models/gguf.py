@@ -176,7 +176,7 @@ def config_from_gguf(g: GGUFFile):
 
     md = g.metadata
     arch = md.get("general.architecture", "llama")
-    if arch not in ("llama", "qwen2"):
+    if arch not in ("llama", "qwen2", "qwen3"):
         raise ValueError(f"unsupported GGUF architecture {arch!r}")
     a = arch
     # qwen2 GGUFs carry QKV bias tensors; detect from the checkpoint so
@@ -200,9 +200,16 @@ def config_from_gguf(g: GGUFFile):
         num_experts_per_tok=int(md.get(f"{a}.expert_used_count", 2) or 2),
         num_heads=int(heads),
         num_kv_heads=int(md.get(f"{a}.attention.head_count_kv", heads)),
-        head_dim=int(md[f"{a}.rope.dimension_count"])
-        if f"{a}.rope.dimension_count" in md
-        else None,
+        # qwen3 GGUFs carry head_dim as attention.key_length (their
+        # head_dim differs from hidden/heads on most sizes); llama-arch
+        # files carry rope.dimension_count.
+        head_dim=(
+            int(md[f"{a}.attention.key_length"])
+            if f"{a}.attention.key_length" in md
+            else int(md[f"{a}.rope.dimension_count"])
+            if f"{a}.rope.dimension_count" in md
+            else None
+        ),
         rope_theta=float(md.get(f"{a}.rope.freq_base", 10000.0)),
         rms_norm_eps=float(
             md.get(f"{a}.attention.layer_norm_rms_epsilon", 1e-5)
@@ -210,6 +217,7 @@ def config_from_gguf(g: GGUFFile):
         max_position_embeddings=int(md.get(f"{a}.context_length", 4096)),
         tie_word_embeddings="output.weight" not in g.tensors,
         attention_bias=has_bias,
+        qk_norm="blk.0.attn_q_norm.weight" in g.tensors,
         model_type=a,
     )
 
@@ -260,6 +268,8 @@ def load_params_from_gguf(path: str, cfg=None):
             "w_gate", "w_up", "w_down"]
     if cfg.attention_bias:
         keys += ["bq", "bk", "bv"]
+    if cfg.qk_norm:
+        keys += ["q_norm", "k_norm"]
     if cfg.is_moe:
         keys.append("router")
     layers: dict[str, list] = {k: [] for k in keys}
@@ -275,6 +285,9 @@ def load_params_from_gguf(path: str, cfg=None):
             layers["bq"].append(g.tensor(p + "attn_q.bias"))
             layers["bk"].append(g.tensor(p + "attn_k.bias"))
             layers["bv"].append(g.tensor(p + "attn_v.bias"))
+        if cfg.qk_norm:
+            layers["q_norm"].append(g.tensor(p + "attn_q_norm.weight"))
+            layers["k_norm"].append(g.tensor(p + "attn_k_norm.weight"))
         if cfg.is_moe:
             # llama.cpp stacks experts in one 3-D tensor per proj:
             # ffn_gate_exps [E, I, D] / ffn_down_exps [E, D, I] (numpy

@@ -72,6 +72,9 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         layers["bq"] = init(ks[9], (L, H * hd), H * hd)
         layers["bk"] = init(ks[10], (L, Hkv * hd), Hkv * hd)
         layers["bv"] = init(ks[11], (L, Hkv * hd), Hkv * hd)
+    if cfg.qk_norm:  # qwen3: per-head q/k RMSNorm
+        layers["q_norm"] = jnp.ones((L, hd), dt)
+        layers["k_norm"] = jnp.ones((L, hd), dt)
     if cfg.is_moe:
         E = cfg.num_experts
         layers["router"] = init(ks[12], (L, D, E), D)
@@ -112,6 +115,9 @@ def param_shardings(
         layers["bq"] = P(None, tp_axis)
         layers["bk"] = P(None, tp_axis)
         layers["bv"] = P(None, tp_axis)
+    if cfg.qk_norm:
+        layers["q_norm"] = P(None, None)
+        layers["k_norm"] = P(None, None)
     if cfg.is_moe:
         # Replicated router; every expert's FFN tp-sharded on the ffn
         # dim (same layout as the dense path, so MoE composes with the
@@ -198,6 +204,9 @@ def _attn_mlp_layer(
     q = q.reshape(B, T, lp["wq"].shape[-1] // hd, hd)
     k = k.reshape(B, T, lp["wk"].shape[-1] // hd, hd)
     v = v.reshape(B, T, lp["wv"].shape[-1] // hd, hd)
+    if "q_norm" in lp:  # qwen3: per-head RMSNorm before rope
+        q = rms_norm(q, lp["q_norm"], eps)
+        k = rms_norm(k, lp["k_norm"], eps)
     q = apply_rope(q, rope_pos, inv_freq)
     k = apply_rope(k, rope_pos, inv_freq)
     attn, kv_extra = attend(q, k, v)

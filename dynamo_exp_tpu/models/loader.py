@@ -37,7 +37,7 @@ def _open_safetensors(path: str):
     return handles, index
 
 
-SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "mixtral")
+SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "qwen3", "mixtral")
 
 
 def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, ModelConfig]:
@@ -74,6 +74,8 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
             "w_gate", "w_up", "w_down"]
     if cfg.attention_bias:
         keys += ["bq", "bk", "bv"]
+    if cfg.qk_norm:
+        keys += ["q_norm", "k_norm"]
     if cfg.is_moe:
         keys.append("router")
     layers: dict[str, list] = {k: [] for k in keys}
@@ -89,6 +91,9 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
             layers["bq"].append(get(p + "self_attn.q_proj.bias"))
             layers["bk"].append(get(p + "self_attn.k_proj.bias"))
             layers["bv"].append(get(p + "self_attn.v_proj.bias"))
+        if cfg.qk_norm:  # qwen3: [head_dim] norms applied per head
+            layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
+            layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
         if cfg.is_moe:
             # Mixtral: w1=gate, w3=up, w2=down, per expert; stack to
             # [E, D, I] / [E, I, D] for the grouped ragged_dot matmuls.

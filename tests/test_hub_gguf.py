@@ -278,3 +278,27 @@ def test_gguf_moe_roundtrip(tmp_path):
         return np.asarray(out)
 
     np.testing.assert_array_equal(logits(loaded), logits(params))
+
+
+def test_gguf_qwen3_head_dim_from_key_length(tmp_path):
+    """qwen3 GGUFs carry head_dim as attention.key_length (no
+    rope.dimension_count); a 2560/32-head file must resolve hd=128,
+    not 80."""
+    write_gguf(
+        str(tmp_path / "q3.gguf"),
+        {
+            "general.architecture": "qwen3",
+            "qwen3.embedding_length": 2560,
+            "qwen3.block_count": 1,
+            "qwen3.attention.head_count": 32,
+            "qwen3.attention.head_count_kv": 8,
+            "qwen3.attention.key_length": 128,
+            "qwen3.feed_forward_length": 9728,
+            "qwen3.vocab_size": 1000,
+        },
+        {"blk.0.attn_q_norm.weight": np.ones(128, np.float32)},
+    )
+    cfg = config_from_gguf(GGUFFile.parse(str(tmp_path / "q3.gguf")))
+    assert cfg.head_dim_ == 128
+    assert cfg.qk_norm
+    assert cfg.model_type == "qwen3"

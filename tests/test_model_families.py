@@ -335,3 +335,14 @@ def test_moe_expert_parallel_matches_single_device():
             np.asarray(logits[0]), want, rtol=1e-3, atol=1e-3,
             err_msg=f"ep={ep} tp={tp}",
         )
+
+
+def test_hf_parity_qwen3(tmp_path, _hf_env):
+    transformers = pytest.importorskip("transformers")
+    c = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=128, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    _parity_check(tmp_path, transformers.Qwen3ForCausalLM(c), c, atol=5e-3)
