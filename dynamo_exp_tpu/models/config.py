@@ -43,16 +43,23 @@ class ModelConfig:
     # Mistral: keys older than (q_pos - sliding_window + 1) are masked.
     # None = full causal attention.
     sliding_window: int | None = None
-    # Sparse MoE (mixtral): 0 experts = dense FFN.
+    # Sparse MoE (mixtral/qwen3_moe): 0 experts = dense FFN.
     num_experts: int = 0
     num_experts_per_tok: int = 2
     norm_topk_prob: bool = True
+    # qwen3_moe: per-expert ffn width differs from the dense
+    # intermediate_size. None = same as intermediate_size (mixtral).
+    moe_intermediate_size: int | None = None
     dtype: str = "bfloat16"
     model_type: str = "llama"
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def expert_intermediate_size(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
 
     @property
     def head_dim_(self) -> int:
@@ -102,6 +109,7 @@ class ModelConfig:
             ) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             norm_topk_prob=cfg.get("norm_topk_prob", True),
+            moe_intermediate_size=cfg.get("moe_intermediate_size"),
             dtype=cfg.get("torch_dtype", "bfloat16"),
             model_type=model_type,
         )

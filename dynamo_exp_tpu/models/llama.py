@@ -76,11 +76,11 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         layers["q_norm"] = jnp.ones((L, hd), dt)
         layers["k_norm"] = jnp.ones((L, hd), dt)
     if cfg.is_moe:
-        E = cfg.num_experts
+        E, Ie = cfg.num_experts, cfg.expert_intermediate_size
         layers["router"] = init(ks[12], (L, D, E), D)
-        layers["w_gate"] = init(ks[5], (L, E, D, I), D)
-        layers["w_up"] = init(ks[6], (L, E, D, I), D)
-        layers["w_down"] = init(ks[7], (L, E, I, D), I)
+        layers["w_gate"] = init(ks[5], (L, E, D, Ie), D)
+        layers["w_up"] = init(ks[6], (L, E, D, Ie), D)
+        layers["w_down"] = init(ks[7], (L, E, Ie, D), Ie)
     else:
         layers["w_gate"] = init(ks[5], (L, D, I), D)
         layers["w_up"] = init(ks[6], (L, D, I), D)

@@ -37,7 +37,9 @@ def _open_safetensors(path: str):
     return handles, index
 
 
-SUPPORTED_MODEL_TYPES = ("llama", "mistral", "qwen2", "qwen3", "mixtral")
+SUPPORTED_MODEL_TYPES = (
+    "llama", "mistral", "qwen2", "qwen3", "mixtral", "qwen3_moe"
+)
 
 
 def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, ModelConfig]:
@@ -95,22 +97,22 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
             layers["q_norm"].append(get(p + "self_attn.q_norm.weight"))
             layers["k_norm"].append(get(p + "self_attn.k_norm.weight"))
         if cfg.is_moe:
-            # Mixtral: w1=gate, w3=up, w2=down, per expert; stack to
-            # [E, D, I] / [E, I, D] for the grouped ragged_dot matmuls.
-            m = p + "block_sparse_moe."
+            # Stack per-expert weights to [E, D, I] / [E, I, D] for the
+            # grouped ragged_dot matmuls. Mixtral names them
+            # block_sparse_moe.experts.N.{w1=gate, w3=up, w2=down};
+            # qwen3_moe uses mlp.experts.N.{gate,up,down}_proj.
+            if cfg.model_type == "qwen3_moe":
+                m = p + "mlp."
+                names = ("gate_proj.weight", "up_proj.weight", "down_proj.weight")
+            else:
+                m = p + "block_sparse_moe."
+                names = ("w1.weight", "w3.weight", "w2.weight")
             layers["router"].append(linear(m + "gate.weight"))
-            layers["w_gate"].append(np.stack([
-                linear(f"{m}experts.{e}.w1.weight")
-                for e in range(cfg.num_experts)
-            ]))
-            layers["w_up"].append(np.stack([
-                linear(f"{m}experts.{e}.w3.weight")
-                for e in range(cfg.num_experts)
-            ]))
-            layers["w_down"].append(np.stack([
-                linear(f"{m}experts.{e}.w2.weight")
-                for e in range(cfg.num_experts)
-            ]))
+            for key, tname in zip(("w_gate", "w_up", "w_down"), names):
+                layers[key].append(np.stack([
+                    linear(f"{m}experts.{e}.{tname}")
+                    for e in range(cfg.num_experts)
+                ]))
         else:
             layers["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
             layers["w_up"].append(linear(p + "mlp.up_proj.weight"))

@@ -346,3 +346,18 @@ def test_hf_parity_qwen3(tmp_path, _hf_env):
         torch_dtype="float32",
     )
     _parity_check(tmp_path, transformers.Qwen3ForCausalLM(c), c, atol=5e-3)
+
+
+def test_hf_parity_qwen3_moe(tmp_path, _hf_env):
+    transformers = pytest.importorskip("transformers")
+    c = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    _parity_check(
+        tmp_path, transformers.Qwen3MoeForCausalLM(c), c, atol=5e-3
+    )
