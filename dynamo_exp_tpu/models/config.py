@@ -40,6 +40,12 @@ class ModelConfig:
     attention_bias: bool = False
     # qwen3: per-head RMSNorm on q and k after projection, before rope.
     qk_norm: bool = False
+    # FFN activation: "silu" (llama/qwen/mistral) or "gelu_tanh" (gemma).
+    hidden_act: str = "silu"
+    # gemma: norm weights are stored as w with scale (1 + w), and the
+    # embedding output is scaled by sqrt(hidden_size).
+    rms_norm_offset: bool = False
+    scale_embeddings: bool = False
     # Mistral: keys older than (q_pos - sliding_window + 1) are masked.
     # None = full causal attention.
     sliding_window: int | None = None
@@ -95,6 +101,16 @@ class ModelConfig:
                 "attention_bias", model_type in ("qwen2", "qwen2_moe")
             ),
             qk_norm=model_type in ("qwen3", "qwen3_moe"),
+            hidden_act=(
+                "gelu_tanh"
+                if str(
+                    cfg.get("hidden_activation")
+                    or cfg.get("hidden_act", "silu")
+                ).startswith("gelu")
+                else "silu"
+            ),
+            rms_norm_offset=model_type == "gemma",
+            scale_embeddings=model_type == "gemma",
             # qwen2 ships a sliding_window value with
             # use_sliding_window=false — honour the switch, or every
             # HF-loaded qwen2 would lose the Pallas decode path and
@@ -238,6 +254,23 @@ QWEN2_7B = ModelConfig(  # Qwen2-7B-Instruct shape
     model_type="qwen2",
 )
 
+GEMMA_2B = ModelConfig(  # Gemma-2B shape
+    vocab_size=256000,
+    hidden_size=2048,
+    intermediate_size=16384,
+    num_layers=18,
+    num_heads=8,
+    num_kv_heads=1,
+    head_dim=256,
+    max_position_embeddings=8192,
+    tie_word_embeddings=True,
+    rms_norm_eps=1e-6,
+    hidden_act="gelu_tanh",
+    rms_norm_offset=True,
+    scale_embeddings=True,
+    model_type="gemma",
+)
+
 MISTRAL_7B = ModelConfig(  # Mistral-7B-v0.1 shape (4k sliding window)
     vocab_size=32000,
     hidden_size=4096,
@@ -274,6 +307,7 @@ PRESETS = {
     "llama-8b": LLAMA_8B,
     "qwen2-7b": QWEN2_7B,
     "qwen3-8b": QWEN3_8B,
+    "gemma-2b": GEMMA_2B,
     "mistral-7b": MISTRAL_7B,
     "mixtral-8x7b": MIXTRAL_8X7B,
 }

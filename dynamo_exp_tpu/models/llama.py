@@ -169,10 +169,27 @@ def init_kv_cache(
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
-def rms_norm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray, w: jnp.ndarray, eps: float, offset: bool = False
+) -> jnp.ndarray:
+    """``offset`` (gemma): weights are stored as w with scale (1 + w),
+    and the whole product stays float32 until one final cast (HF
+    GemmaRMSNorm) — (w + 1) in bf16 would round away exactly the
+    near-1.0 precision the storage convention exists to keep. The
+    non-offset path multiplies after the downcast, matching HF
+    LlamaRMSNorm."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    normed = xf * jax.lax.rsqrt(var + eps)
+    if offset:
+        return (normed * (w.astype(jnp.float32) + 1.0)).astype(x.dtype)
+    return normed.astype(x.dtype) * w
+
+
+def _act(name: str):
+    if name == "gelu_tanh":
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu
 
 
 def _attn_mlp_layer(
@@ -196,8 +213,9 @@ def _attn_mlp_layer(
     """
     B, T = x.shape[:2]
     hd = cfg.head_dim_
+    off = cfg.rms_norm_offset
     red = reduce if reduce is not None else (lambda y: y)
-    h = rms_norm(x, lp["attn_norm"], eps)
+    h = rms_norm(x, lp["attn_norm"], eps, off)
     q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
@@ -211,7 +229,7 @@ def _attn_mlp_layer(
     k = apply_rope(k, rope_pos, inv_freq)
     attn, kv_extra = attend(q, k, v)
     x = x + red(attn.reshape(B, T, -1) @ lp["wo"])
-    h = rms_norm(x, lp["mlp_norm"], eps)
+    h = rms_norm(x, lp["mlp_norm"], eps, off)
     if "router" in lp:
         from ..ops.moe import moe_ffn, moe_ffn_ep
 
@@ -236,15 +254,23 @@ def _attn_mlp_layer(
             ).reshape(B, T, -1)
             x = x + red(y)
     else:
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
+        act = _act(cfg.hidden_act)
+        gate = act((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
         x = x + red((gate * (h @ lp["w_up"])) @ lp["w_down"])
     return x, kv_extra
 
 
 def _final_logits(params, cfg, x, eps):
-    x = rms_norm(x, params["final_norm"], eps)
+    x = rms_norm(x, params["final_norm"], eps, cfg.rms_norm_offset)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     return (x @ head).astype(jnp.float32)
+
+
+def _maybe_scale_embeds(cfg, x):
+    if not cfg.scale_embeddings:
+        return x
+    # gemma scales by sqrt(hidden) rounded through the param dtype.
+    return x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
 
 
 def forward(
@@ -308,11 +334,20 @@ def forward(
         if token_embeds is not None
         else jnp.take(params["embed"], tokens, axis=0)
     )  # [B, T, D]
+    x = _maybe_scale_embeds(cfg, x)
     rope_pos = jnp.maximum(positions, 0)
 
     # Pallas decode reads full ragged context; sliding-window models
-    # stay on the XLA path where the window mask lives.
-    use_pallas = attn_impl == "pallas" and T == 1 and cfg.sliding_window is None
+    # stay on the XLA path where the window mask lives, as do meshes
+    # whose tp doesn't divide the kv heads (e.g. gemma's Hkv=1 with
+    # tp>1 — the shard_map head split would be empty on some ranks).
+    tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
+    use_pallas = (
+        attn_impl == "pallas"
+        and T == 1
+        and cfg.sliding_window is None
+        and cfg.num_kv_heads % tp_size == 0
+    )
     if use_pallas:
         lengths = jnp.maximum(positions[:, 0] + 1, 0)
     attn_table = (
@@ -499,7 +534,7 @@ def forward_ring_prefill(
         check_vma=False,
     )
     def fwd(params_l, tokens_l, pos_l):
-        x = embed_lookup(params_l["embed"], tokens_l)
+        x = _maybe_scale_embeds(cfg, embed_lookup(params_l["embed"], tokens_l))
         rope_pos = jnp.maximum(pos_l, 0)
 
         def layer(x, lp):

@@ -38,7 +38,7 @@ def _open_safetensors(path: str):
 
 
 SUPPORTED_MODEL_TYPES = (
-    "llama", "mistral", "qwen2", "qwen3", "mixtral", "qwen3_moe"
+    "llama", "mistral", "qwen2", "qwen3", "gemma", "mixtral", "qwen3_moe"
 )
 
 

@@ -361,3 +361,17 @@ def test_hf_parity_qwen3_moe(tmp_path, _hf_env):
     _parity_check(
         tmp_path, transformers.Qwen3MoeForCausalLM(c), c, atol=5e-3
     )
+
+
+def test_hf_parity_gemma(tmp_path, _hf_env):
+    transformers = pytest.importorskip("transformers")
+    c = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=128, torch_dtype="float32",
+    )
+    # Gemma always ties embeddings; eager attention for exactness.
+    model = transformers.GemmaForCausalLM._from_config(
+        c, attn_implementation="eager"
+    )
+    _parity_check(tmp_path, model, c, atol=5e-3)
