@@ -214,7 +214,33 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
     }
 
 
+def _probe_device(timeout_s: float = 180.0) -> None:
+    """Fail fast (clear error, rc=1) when the accelerator backend is
+    unreachable — jax.devices() against a dead TPU tunnel blocks
+    indefinitely, which would otherwise hang the whole bench run."""
+    import subprocess
+    import sys
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            check=True,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"accelerator backend unreachable (device init exceeded "
+            f"{timeout_s:.0f}s) — TPU tunnel down?"
+        ) from None
+    except subprocess.CalledProcessError as e:
+        raise SystemExit(
+            f"device init failed: {e.stderr.decode(errors='replace')[-500:]}"
+        ) from None
+
+
 def main() -> None:
+    global MODEL
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--sweep",
@@ -226,7 +252,10 @@ def main() -> None:
         action="store_true",
         help="warm-prefix vs cold TTFT (the KV-reuse headline claim)",
     )
+    ap.add_argument("--model", default=MODEL, help="preset name")
     args = ap.parse_args()
+    MODEL = args.model
+    _probe_device()
     if args.sweep:
         for c in SWEEP_CONCURRENCY:
             print(json.dumps(run_point(SWEEP_ISL, SWEEP_OSL, c)), flush=True)
