@@ -375,3 +375,64 @@ def test_hf_parity_gemma(tmp_path, _hf_env):
         c, attn_implementation="eager"
     )
     _parity_check(tmp_path, model, c, atol=5e-3)
+
+
+@pytest.mark.parametrize(
+    "preset", ["tiny", "tiny-qwen2", "tiny-qwen3", "tiny-moe", "tiny-gemma"]
+)
+async def test_engine_serves_every_family(preset):
+    """Engine e2e per family: greedy decode through the full continuous-
+    batching stack must equal the bare-forward oracle — catches family
+    plumbing breaks (penalty counts, prefix cache, decode windows) that
+    forward-level parity tests can't."""
+    import dataclasses
+
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models import PRESETS
+    from dynamo_exp_tpu.parallel import single_device_mesh
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    if preset == "tiny-gemma":
+        mcfg = dataclasses.replace(
+            PRESETS["tiny"], hidden_act="gelu_tanh", rms_norm_offset=True,
+            scale_embeddings=True, model_type="gemma",
+        )
+    else:
+        mcfg = PRESETS[preset]
+    cfg = EngineConfig(
+        model=mcfg, max_decode_slots=2, page_size=PS, num_pages=32,
+        max_model_len=128, eos_token_ids=[],
+    )
+    engine = TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+    engine.start()
+    try:
+        prompt = [5, 9, 17, 3, 11, 2]
+        params = engine.params
+        pmax = 8
+        k, v = init_kv_cache(mcfg, num_pages=pmax + 1, page_size=PS)
+        table = jnp.arange(pmax, dtype=jnp.int32)[None, :] + 1
+        logits, k, v = forward(
+            params, mcfg,
+            jnp.array([prompt], jnp.int32),
+            jnp.arange(len(prompt), dtype=jnp.int32)[None, :], table, k, v,
+        )
+        want = [int(np.asarray(logits)[0, -1].argmax())]
+        for _ in range(4):
+            pos = len(prompt) + len(want) - 1
+            logits, k, v = forward(
+                params, mcfg,
+                jnp.array([[want[-1]]], jnp.int32),
+                jnp.array([[pos]], jnp.int32), table, k, v,
+            )
+            want.append(int(np.asarray(logits)[0, 0].argmax()))
+
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = 5
+        b.stop_conditions.ignore_eos = True
+        stream = await engine.generate(b.to_dict())
+        got = []
+        async for item in stream:
+            got.extend(item.get("token_ids", []))
+        assert got == want, f"family {preset} engine/oracle mismatch"
+    finally:
+        engine.stop()
