@@ -56,6 +56,9 @@ class ModelConfig:
     # qwen3_moe: per-expert ffn width differs from the dense
     # intermediate_size. None = same as intermediate_size (mixtral).
     moe_intermediate_size: int | None = None
+    # qwen2_moe: an always-on shared expert of this width, blended via
+    # a learned sigmoid gate. None = no shared expert.
+    shared_expert_intermediate_size: int | None = None
     dtype: str = "bfloat16"
     model_type: str = "llama"
 
@@ -82,6 +85,18 @@ class ModelConfig:
         ``attention_bias`` key); mistral/mixtral carry ``sliding_window``;
         mixtral's experts are ``num_local_experts``."""
         model_type = cfg.get("model_type", "llama")
+        if model_type in ("qwen2_moe", "qwen3_moe") and (
+            cfg.get("mlp_only_layers") or cfg.get("decoder_sparse_step", 1) != 1
+        ):
+            # Per-layer dense/sparse mixing stores mlp.gate_proj for the
+            # dense layers — the stacked-scan loader assumes a uniform
+            # layer shape; fail loudly here instead of a bare KeyError
+            # deep in the tensor loop.
+            raise ValueError(
+                "qwen MoE checkpoints with mlp_only_layers / "
+                "decoder_sparse_step != 1 (mixed dense+sparse layers) "
+                "are not supported"
+            )
         return cls(
             vocab_size=cfg.get("vocab_size", 32000),
             hidden_size=cfg.get("hidden_size", 4096),
@@ -126,6 +141,9 @@ class ModelConfig:
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             norm_topk_prob=cfg.get("norm_topk_prob", True),
             moe_intermediate_size=cfg.get("moe_intermediate_size"),
+            shared_expert_intermediate_size=cfg.get(
+                "shared_expert_intermediate_size"
+            ),
             dtype=cfg.get("torch_dtype", "bfloat16"),
             model_type=model_type,
         )

@@ -55,7 +55,7 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         cfg.intermediate_size,
         cfg.vocab_size,
     )
-    ks = jax.random.split(rng, 13)
+    ks = jax.random.split(rng, 17)
 
     def init(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5).astype(dt)
@@ -81,6 +81,12 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         layers["w_gate"] = init(ks[5], (L, E, D, Ie), D)
         layers["w_up"] = init(ks[6], (L, E, D, Ie), D)
         layers["w_down"] = init(ks[7], (L, E, Ie, D), Ie)
+        if cfg.shared_expert_intermediate_size:  # qwen2_moe
+            Is = cfg.shared_expert_intermediate_size
+            layers["shared_gate"] = init(ks[13], (L, D, Is), D)
+            layers["shared_up"] = init(ks[14], (L, D, Is), D)
+            layers["shared_down"] = init(ks[15], (L, Is, D), Is)
+            layers["shared_router"] = init(ks[16], (L, D), D)
     else:
         layers["w_gate"] = init(ks[5], (L, D, I), D)
         layers["w_up"] = init(ks[6], (L, D, I), D)
@@ -127,6 +133,11 @@ def param_shardings(
         layers["w_gate"] = P(None, ep_axis, None, tp_axis)
         layers["w_up"] = P(None, ep_axis, None, tp_axis)
         layers["w_down"] = P(None, ep_axis, tp_axis, None)
+        if cfg.shared_expert_intermediate_size:
+            layers["shared_gate"] = P(None, None, tp_axis)
+            layers["shared_up"] = P(None, None, tp_axis)
+            layers["shared_down"] = P(None, tp_axis, None)
+            layers["shared_router"] = P(None, None)
     else:
         layers["w_gate"] = P(None, None, tp_axis)
         layers["w_up"] = P(None, None, tp_axis)
@@ -233,15 +244,30 @@ def _attn_mlp_layer(
     if "router" in lp:
         from ..ops.moe import moe_ffn, moe_ffn_ep
 
+        shared = None
+        if "shared_gate" in lp:  # qwen2_moe: always-on gated shared expert
+            act = _act(cfg.hidden_act)
+            sg = act((h @ lp["shared_gate"]).astype(jnp.float32)).astype(x.dtype)
+            s_out = (sg * (h @ lp["shared_up"])) @ lp["shared_down"]
+            # Learned sigmoid blend; the gate logit uses the replicated
+            # [D] vector, so it is identical on every tp rank and
+            # commutes with the psum over the I-sharded shared FFN.
+            blend = jax.nn.sigmoid(
+                (h @ lp["shared_router"]).astype(jnp.float32)
+            )[..., None]
+            shared = (blend * s_out.astype(jnp.float32)).astype(x.dtype)
         if mesh is not None and mesh.shape.get("ep", 1) > 1:
             # Experts sharded over the mesh's ep axis (shard_map path);
-            # the psum inside covers both ep and tp, so no outer reduce.
+            # the psum inside covers both ep and tp, so no outer reduce
+            # (the shared expert stays on the GSPMD path).
             y = moe_ffn_ep(
                 h.reshape(B * T, -1),
                 lp["router"], lp["w_gate"], lp["w_up"], lp["w_down"],
                 cfg.num_experts_per_tok, cfg.norm_topk_prob, mesh,
             ).reshape(B, T, -1)
             x = x + y
+            if shared is not None:
+                x = x + red(shared)
         else:
             y = moe_ffn(
                 h.reshape(B * T, -1),
@@ -252,6 +278,8 @@ def _attn_mlp_layer(
                 cfg.num_experts_per_tok,
                 cfg.norm_topk_prob,
             ).reshape(B, T, -1)
+            if shared is not None:
+                y = y + shared
             x = x + red(y)
     else:
         act = _act(cfg.hidden_act)

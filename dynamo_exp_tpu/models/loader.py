@@ -38,7 +38,8 @@ def _open_safetensors(path: str):
 
 
 SUPPORTED_MODEL_TYPES = (
-    "llama", "mistral", "qwen2", "qwen3", "gemma", "mixtral", "qwen3_moe"
+    "llama", "mistral", "qwen2", "qwen3", "gemma", "mixtral",
+    "qwen2_moe", "qwen3_moe",
 )
 
 
@@ -80,6 +81,8 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
         keys += ["q_norm", "k_norm"]
     if cfg.is_moe:
         keys.append("router")
+        if cfg.shared_expert_intermediate_size:
+            keys += ["shared_gate", "shared_up", "shared_down", "shared_router"]
     layers: dict[str, list] = {k: [] for k in keys}
     for i in range(L):
         p = f"{pre}layers.{i}."
@@ -101,7 +104,7 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
             # grouped ragged_dot matmuls. Mixtral names them
             # block_sparse_moe.experts.N.{w1=gate, w3=up, w2=down};
             # qwen3_moe uses mlp.experts.N.{gate,up,down}_proj.
-            if cfg.model_type == "qwen3_moe":
+            if cfg.model_type in ("qwen2_moe", "qwen3_moe"):
                 m = p + "mlp."
                 names = ("gate_proj.weight", "up_proj.weight", "down_proj.weight")
             else:
@@ -113,6 +116,15 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
                     linear(f"{m}experts.{e}.{tname}")
                     for e in range(cfg.num_experts)
                 ]))
+            if cfg.shared_expert_intermediate_size:  # qwen2_moe
+                s = p + "mlp.shared_expert."
+                layers["shared_gate"].append(linear(s + "gate_proj.weight"))
+                layers["shared_up"].append(linear(s + "up_proj.weight"))
+                layers["shared_down"].append(linear(s + "down_proj.weight"))
+                # shared_expert_gate is Linear(D, 1): [1, D] -> [D]
+                layers["shared_router"].append(
+                    get(p + "mlp.shared_expert_gate.weight")[0]
+                )
         else:
             layers["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
             layers["w_up"].append(linear(p + "mlp.up_proj.weight"))

@@ -176,57 +176,6 @@ def test_moe_tp_sharded_matches_single_device():
     np.testing.assert_allclose(np.asarray(logits[0]), want, rtol=1e-3, atol=1e-3)
 
 
-async def test_engine_serves_moe_model():
-    """The continuous-batching engine must serve a sparse-MoE model
-    end-to-end (greedy decode == direct-forward oracle)."""
-    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
-    from dynamo_exp_tpu.parallel import single_device_mesh
-    from dynamo_exp_tpu.protocols.common import BackendInput
-
-    cfg = EngineConfig(
-        model=TINY_MOE, max_decode_slots=2, page_size=PS, num_pages=32,
-        max_model_len=128, eos_token_ids=[],
-    )
-    engine = TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
-    engine.start()
-    try:
-        prompt = [5, 9, 17, 3, 11]
-        # Oracle: greedy decode through the bare forward with the
-        # engine's own params.
-        params = engine.params
-        pmax = 8
-        k, v = init_kv_cache(TINY_MOE, num_pages=pmax + 1, page_size=PS)
-        table = jnp.arange(pmax, dtype=jnp.int32)[None, :] + 1
-        logits, k, v = forward(
-            params, TINY_MOE,
-            jnp.array([prompt], jnp.int32),
-            jnp.arange(len(prompt), dtype=jnp.int32)[None, :], table, k, v,
-        )
-        want = []
-        cur = int(np.asarray(logits)[0, -1].argmax())
-        want.append(cur)
-        for step in range(5):
-            pos = len(prompt) + len(want) - 1
-            logits, k, v = forward(
-                params, TINY_MOE,
-                jnp.array([[cur]], jnp.int32),
-                jnp.array([[pos]], jnp.int32), table, k, v,
-            )
-            cur = int(np.asarray(logits)[0, 0].argmax())
-            want.append(cur)
-
-        b = BackendInput(token_ids=prompt)
-        b.stop_conditions.max_tokens = 6
-        b.stop_conditions.ignore_eos = True
-        stream = await engine.generate(b.to_dict())
-        got = []
-        async for item in stream:
-            got.extend(item.get("token_ids", []))
-        assert got == want
-    finally:
-        engine.stop()
-
-
 # ---------------------------------------------------------------------------
 # HF transformers parity: tiny random checkpoints saved to disk, loaded by
 # our loader, logits compared to the HF torch forward.
@@ -378,7 +327,9 @@ def test_hf_parity_gemma(tmp_path, _hf_env):
 
 
 @pytest.mark.parametrize(
-    "preset", ["tiny", "tiny-qwen2", "tiny-qwen3", "tiny-moe", "tiny-gemma"]
+    "preset",
+    ["tiny", "tiny-qwen2", "tiny-qwen3", "tiny-moe", "tiny-shared-moe",
+     "tiny-gemma"]
 )
 async def test_engine_serves_every_family(preset):
     """Engine e2e per family: greedy decode through the full continuous-
@@ -392,7 +343,12 @@ async def test_engine_serves_every_family(preset):
     from dynamo_exp_tpu.parallel import single_device_mesh
     from dynamo_exp_tpu.protocols.common import BackendInput
 
-    if preset == "tiny-gemma":
+    if preset == "tiny-shared-moe":  # qwen2_moe: shared expert + gate
+        mcfg = dataclasses.replace(
+            PRESETS["tiny-moe"], shared_expert_intermediate_size=80,
+            norm_topk_prob=False, model_type="qwen2_moe",
+        )
+    elif preset == "tiny-gemma":
         mcfg = dataclasses.replace(
             PRESETS["tiny"], hidden_act="gelu_tanh", rms_norm_offset=True,
             scale_embeddings=True, model_type="gemma",
@@ -436,3 +392,18 @@ async def test_engine_serves_every_family(preset):
         assert got == want, f"family {preset} engine/oracle mismatch"
     finally:
         engine.stop()
+
+
+def test_hf_parity_qwen2_moe(tmp_path, _hf_env):
+    transformers = pytest.importorskip("transformers")
+    c = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=56,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    _parity_check(
+        tmp_path, transformers.Qwen2MoeForCausalLM(c), c, atol=5e-3
+    )
