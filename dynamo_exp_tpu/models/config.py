@@ -19,8 +19,10 @@ class ModelConfig:
     """Decoder-transformer architecture hyperparameters. One config class
     covers the supported families — llama (Llama 2/3,
     DeepSeek-R1-Distill-Llama, TinyLlama), mistral (sliding-window
-    attention), qwen2 (QKV bias), mixtral/qwen2-style sparse MoE — with
-    family differences expressed as fields, not subclasses, so the single
+    attention), qwen2 (QKV bias), qwen3 (per-head q/k norm), gemma
+    (gelu FFN, +1 norm offset, scaled embeddings), and the sparse-MoE
+    line mixtral / qwen2_moe (shared expert) / qwen3_moe — with family
+    differences expressed as fields, not subclasses, so the single
     scan-over-layers forward stays one compiled program per family."""
 
     vocab_size: int = 32000
