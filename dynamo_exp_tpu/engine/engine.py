@@ -181,6 +181,18 @@ class TPUEngine(AsyncEngine):
         if impl == "auto":
             platform = self.mesh.devices.flat[0].platform
             impl = "pallas" if (platform == "tpu" or interpret) else "xla"
+        mcfg = cfg.model
+        if impl == "pallas" and (
+            mcfg.sliding_window is not None
+            or mcfg.attn_logit_softcap is not None
+            or mcfg.query_pre_attn_scalar is not None
+        ):
+            # forward() would silently refuse the kernel for these
+            # configs (window mask / softcap / scale live on the XLA
+            # path); resolve xla HERE so attn_pages keeps bounding the
+            # gather — otherwise decode would run the XLA path with an
+            # unbounded Pmax-wide page table.
+            impl = "xla"
         if impl == "pallas" and not interpret:
             tp = self.mesh.shape.get("tp", 1)
             if not pallas_supported(

@@ -48,6 +48,15 @@ class ModelConfig:
     # embedding output is scaled by sqrt(hidden_size).
     rms_norm_offset: bool = False
     scale_embeddings: bool = False
+    # gemma2: extra norms on the attention and FFN OUTPUTS (4 norms per
+    # layer), tanh softcaps on attention scores and final logits, an
+    # explicit attention scale, and sliding window on alternating
+    # (even) layers only.
+    post_norms: bool = False
+    attn_logit_softcap: float | None = None
+    final_logit_softcap: float | None = None
+    query_pre_attn_scalar: float | None = None
+    alt_sliding_window: bool = False
     # Mistral: keys older than (q_pos - sliding_window + 1) are masked.
     # None = full causal attention.
     sliding_window: int | None = None
@@ -126,8 +135,13 @@ class ModelConfig:
                 ).startswith("gelu")
                 else "silu"
             ),
-            rms_norm_offset=model_type == "gemma",
-            scale_embeddings=model_type == "gemma",
+            rms_norm_offset=model_type in ("gemma", "gemma2"),
+            scale_embeddings=model_type in ("gemma", "gemma2"),
+            post_norms=model_type == "gemma2",
+            attn_logit_softcap=cfg.get("attn_logit_softcapping"),
+            final_logit_softcap=cfg.get("final_logit_softcapping"),
+            query_pre_attn_scalar=cfg.get("query_pre_attn_scalar"),
+            alt_sliding_window=model_type == "gemma2",
             # qwen2 ships a sliding_window value with
             # use_sliding_window=false — honour the switch, or every
             # HF-loaded qwen2 would lose the Pallas decode path and
@@ -291,6 +305,29 @@ GEMMA_2B = ModelConfig(  # Gemma-2B shape
     model_type="gemma",
 )
 
+GEMMA2_9B = ModelConfig(  # Gemma-2-9B shape
+    vocab_size=256000,
+    hidden_size=3584,
+    intermediate_size=14336,
+    num_layers=42,
+    num_heads=16,
+    num_kv_heads=8,
+    head_dim=256,
+    max_position_embeddings=8192,
+    tie_word_embeddings=True,
+    rms_norm_eps=1e-6,
+    hidden_act="gelu_tanh",
+    rms_norm_offset=True,
+    scale_embeddings=True,
+    post_norms=True,
+    attn_logit_softcap=50.0,
+    final_logit_softcap=30.0,
+    query_pre_attn_scalar=256.0,
+    sliding_window=4096,
+    alt_sliding_window=True,
+    model_type="gemma2",
+)
+
 MISTRAL_7B = ModelConfig(  # Mistral-7B-v0.1 shape (4k sliding window)
     vocab_size=32000,
     hidden_size=4096,
@@ -328,6 +365,7 @@ PRESETS = {
     "qwen2-7b": QWEN2_7B,
     "qwen3-8b": QWEN3_8B,
     "gemma-2b": GEMMA_2B,
+    "gemma2-9b": GEMMA2_9B,
     "mistral-7b": MISTRAL_7B,
     "mixtral-8x7b": MIXTRAL_8X7B,
 }

@@ -75,6 +75,10 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     if cfg.qk_norm:  # qwen3: per-head q/k RMSNorm
         layers["q_norm"] = jnp.ones((L, hd), dt)
         layers["k_norm"] = jnp.ones((L, hd), dt)
+    if cfg.post_norms:  # gemma2: norms on block outputs too
+        init_norm = jnp.zeros if cfg.rms_norm_offset else jnp.ones
+        layers["post_attn_norm"] = init_norm((L, D), dt)
+        layers["post_ffn_norm"] = init_norm((L, D), dt)
     if cfg.is_moe:
         E, Ie = cfg.num_experts, cfg.expert_intermediate_size
         layers["router"] = init(ks[12], (L, D, E), D)
@@ -124,6 +128,9 @@ def param_shardings(
     if cfg.qk_norm:
         layers["q_norm"] = P(None, None)
         layers["k_norm"] = P(None, None)
+    if cfg.post_norms:
+        layers["post_attn_norm"] = P(None, None)
+        layers["post_ffn_norm"] = P(None, None)
     if cfg.is_moe:
         # Replicated router; every expert's FFN tp-sharded on the ffn
         # dim (same layout as the dense path, so MoE composes with the
@@ -239,7 +246,10 @@ def _attn_mlp_layer(
     q = apply_rope(q, rope_pos, inv_freq)
     k = apply_rope(k, rope_pos, inv_freq)
     attn, kv_extra = attend(q, k, v)
-    x = x + red(attn.reshape(B, T, -1) @ lp["wo"])
+    attn_out = red(attn.reshape(B, T, -1) @ lp["wo"])
+    if "post_attn_norm" in lp:  # gemma2: norm the block OUTPUT too
+        attn_out = rms_norm(attn_out, lp["post_attn_norm"], eps, off)
+    x = x + attn_out
     h = rms_norm(x, lp["mlp_norm"], eps, off)
     if "router" in lp:
         from ..ops.moe import moe_ffn, moe_ffn_ep
@@ -284,14 +294,21 @@ def _attn_mlp_layer(
     else:
         act = _act(cfg.hidden_act)
         gate = act((h @ lp["w_gate"]).astype(jnp.float32)).astype(x.dtype)
-        x = x + red((gate * (h @ lp["w_up"])) @ lp["w_down"])
+        ffn_out = red((gate * (h @ lp["w_up"])) @ lp["w_down"])
+        if "post_ffn_norm" in lp:  # gemma2
+            ffn_out = rms_norm(ffn_out, lp["post_ffn_norm"], eps, off)
+        x = x + ffn_out
     return x, kv_extra
 
 
 def _final_logits(params, cfg, x, eps):
     x = rms_norm(x, params["final_norm"], eps, cfg.rms_norm_offset)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    logits = (x @ head).astype(jnp.float32)
+    if cfg.final_logit_softcap is not None:  # gemma2
+        cap = cfg.final_logit_softcap
+        logits = jnp.tanh(logits / cap) * cap
+    return logits
 
 
 def _maybe_scale_embeds(cfg, x):
@@ -365,15 +382,18 @@ def forward(
     x = _maybe_scale_embeds(cfg, x)
     rope_pos = jnp.maximum(positions, 0)
 
-    # Pallas decode reads full ragged context; sliding-window models
-    # stay on the XLA path where the window mask lives, as do meshes
-    # whose tp doesn't divide the kv heads (e.g. gemma's Hkv=1 with
-    # tp>1 — the shard_map head split would be empty on some ranks).
+    # Pallas decode reads full ragged context; sliding-window and
+    # softcapped (gemma2) models stay on the XLA path where those live,
+    # as do meshes whose tp doesn't divide the kv heads (e.g. gemma's
+    # Hkv=1 with tp>1 — the shard_map head split would be empty on some
+    # ranks).
     tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
     use_pallas = (
         attn_impl == "pallas"
         and T == 1
         and cfg.sliding_window is None
+        and cfg.attn_logit_softcap is None
+        and cfg.query_pre_attn_scalar is None
         and cfg.num_kv_heads % tp_size == 0
     )
     if use_pallas:
@@ -381,9 +401,27 @@ def forward(
     attn_table = (
         page_table if attn_pages is None else page_table[:, :attn_pages]
     )
+    sm_scale = (
+        cfg.query_pre_attn_scalar ** -0.5
+        if cfg.query_pre_attn_scalar
+        else None
+    )
+    # Per-layer window widths ride the scan (gemma2 alternates sliding
+    # and full layers; mistral uses one width everywhere). 1<<30 ≈ no
+    # window for the full-attention layers.
+    have_window = cfg.sliding_window is not None
+    win_arr = jnp.asarray(
+        [
+            cfg.sliding_window
+            if (have_window and (not cfg.alt_sliding_window or i % 2 == 0))
+            else 1 << 30
+            for i in range(cfg.num_layers)
+        ],
+        jnp.int32,
+    )
 
     def layer(x, layer_in):
-        lp, k_pool, v_pool = layer_in
+        lp, k_pool, v_pool, win_l = layer_in
 
         def attend(q, k, v):
             kp, vp = write_kv_pages(
@@ -410,7 +448,9 @@ def forward(
             return (
                 paged_attention(
                     q, kp, vp, attn_table, positions,
-                    window=cfg.sliding_window,
+                    sm_scale=sm_scale,
+                    window=win_l if have_window else None,
+                    softcap=cfg.attn_logit_softcap,
                 ),
                 (kp, vp),
             )
@@ -420,7 +460,7 @@ def forward(
         )
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], k_cache, v_cache)
+        layer, x, (params["layers"], k_cache, v_cache, win_arr)
     )
     if last_positions is not None:
         x = jnp.take_along_axis(x, last_positions[:, None, None], axis=1)

@@ -38,7 +38,7 @@ def _open_safetensors(path: str):
 
 
 SUPPORTED_MODEL_TYPES = (
-    "llama", "mistral", "qwen2", "qwen3", "gemma", "mixtral",
+    "llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2", "mixtral",
     "qwen2_moe", "qwen3_moe",
 )
 
@@ -79,6 +79,8 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
         keys += ["bq", "bk", "bv"]
     if cfg.qk_norm:
         keys += ["q_norm", "k_norm"]
+    if cfg.post_norms:
+        keys += ["post_attn_norm", "post_ffn_norm"]
     if cfg.is_moe:
         keys.append("router")
         if cfg.shared_expert_intermediate_size:
@@ -91,7 +93,23 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
         layers["wk"].append(linear(p + "self_attn.k_proj.weight"))
         layers["wv"].append(linear(p + "self_attn.v_proj.weight"))
         layers["wo"].append(linear(p + "self_attn.o_proj.weight"))
-        layers["mlp_norm"].append(get(p + "post_attention_layernorm.weight"))
+        if cfg.post_norms:
+            # gemma2 layer norms: post_attention_layernorm norms the
+            # attn OUTPUT; pre_feedforward_layernorm is the pre-FFN
+            # norm (the role post_attention_layernorm plays elsewhere).
+            layers["post_attn_norm"].append(
+                get(p + "post_attention_layernorm.weight")
+            )
+            layers["mlp_norm"].append(
+                get(p + "pre_feedforward_layernorm.weight")
+            )
+            layers["post_ffn_norm"].append(
+                get(p + "post_feedforward_layernorm.weight")
+            )
+        else:
+            layers["mlp_norm"].append(
+                get(p + "post_attention_layernorm.weight")
+            )
         if cfg.attention_bias:  # qwen2: bias on q/k/v only
             layers["bq"].append(get(p + "self_attn.q_proj.bias"))
             layers["bk"].append(get(p + "self_attn.k_proj.bias"))

@@ -64,14 +64,17 @@ def paged_attention(
     page_table: jnp.ndarray,  # [B, Pmax] int32
     q_positions: jnp.ndarray,  # [B, T] int32 global position of each query
     sm_scale: float | None = None,
-    window: int | None = None,
+    window: int | jnp.ndarray | None = None,
+    softcap: float | None = None,
 ) -> jnp.ndarray:
     """Causal attention of queries against their sequences' pages.
 
     Returns [B, T, H, D]. Positions beyond a query's own position are
     masked, so garbage in not-yet-written slots never leaks. ``window``
-    (mistral sliding-window attention) additionally masks keys older
-    than ``q_pos - window + 1``.
+    (mistral/gemma2 sliding-window attention) additionally masks keys
+    older than ``q_pos - window + 1`` — it may be a traced scalar, so a
+    scan over layers can alternate window widths (gemma2). ``softcap``
+    applies gemma2's tanh cap to the scores before masking.
     """
     B, T, H, D = q.shape
     P, ps, _ = k_cache.shape
@@ -94,6 +97,8 @@ def paged_attention(
         * scale
     )  # [B,Hkv,qpk,T,S] f32
 
+    if softcap is not None:
+        scores = jnp.tanh(scores / softcap) * softcap
     kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, None, None, :]
     qp = q_positions[:, None, None, :, None]
     mask = kv_pos <= qp  # causal by position

@@ -407,3 +407,21 @@ def test_hf_parity_qwen2_moe(tmp_path, _hf_env):
     _parity_check(
         tmp_path, transformers.Qwen2MoeForCausalLM(c), c, atol=5e-3
     )
+
+
+def test_hf_parity_gemma2(tmp_path, _hf_env):
+    """gemma2: 4 norms/layer, attn+final softcaps, query scale, and
+    sliding window on alternating layers (T > window exercises the
+    alternation)."""
+    transformers = pytest.importorskip("transformers")
+    c = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=128, sliding_window=6,
+        query_pre_attn_scalar=8, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, torch_dtype="float32",
+    )
+    model = transformers.Gemma2ForCausalLM._from_config(
+        c, attn_implementation="eager"
+    )
+    _parity_check(tmp_path, model, c, n_tokens=16, atol=5e-3)
