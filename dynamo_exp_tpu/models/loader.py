@@ -38,8 +38,8 @@ def _open_safetensors(path: str):
 
 
 SUPPORTED_MODEL_TYPES = (
-    "llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2", "mixtral",
-    "qwen2_moe", "qwen3_moe",
+    "llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2", "phi3",
+    "mixtral", "qwen2_moe", "qwen3_moe",
 )
 
 
@@ -89,9 +89,19 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
     for i in range(L):
         p = f"{pre}layers.{i}."
         layers["attn_norm"].append(get(p + "input_layernorm.weight"))
-        layers["wq"].append(linear(p + "self_attn.q_proj.weight"))
-        layers["wk"].append(linear(p + "self_attn.k_proj.weight"))
-        layers["wv"].append(linear(p + "self_attn.v_proj.weight"))
+        if cfg.model_type == "phi3":
+            # phi3 packs q/k/v into one tensor [(H + 2*Hkv)*hd, D].
+            qkv = get(p + "self_attn.qkv_proj.weight")
+            hd = cfg.head_dim_
+            nq = cfg.num_heads * hd
+            nk = cfg.num_kv_heads * hd
+            layers["wq"].append(qkv[:nq].T)
+            layers["wk"].append(qkv[nq : nq + nk].T)
+            layers["wv"].append(qkv[nq + nk :].T)
+        else:
+            layers["wq"].append(linear(p + "self_attn.q_proj.weight"))
+            layers["wk"].append(linear(p + "self_attn.k_proj.weight"))
+            layers["wv"].append(linear(p + "self_attn.v_proj.weight"))
         layers["wo"].append(linear(p + "self_attn.o_proj.weight"))
         if cfg.post_norms:
             # gemma2 layer norms: post_attention_layernorm norms the
@@ -143,6 +153,12 @@ def load_params(path: str, cfg: ModelConfig | None = None) -> tuple[Params, Mode
                 layers["shared_router"].append(
                     get(p + "mlp.shared_expert_gate.weight")[0]
                 )
+        elif cfg.model_type == "phi3":
+            # phi3 packs gate and up into one tensor [2I, D].
+            gu = get(p + "mlp.gate_up_proj.weight")
+            layers["w_gate"].append(gu[: cfg.intermediate_size].T)
+            layers["w_up"].append(gu[cfg.intermediate_size :].T)
+            layers["w_down"].append(linear(p + "mlp.down_proj.weight"))
         else:
             layers["w_gate"].append(linear(p + "mlp.gate_proj.weight"))
             layers["w_up"].append(linear(p + "mlp.up_proj.weight"))

@@ -425,3 +425,18 @@ def test_hf_parity_gemma2(tmp_path, _hf_env):
         c, attn_implementation="eager"
     )
     _parity_check(tmp_path, model, c, n_tokens=16, atol=5e-3)
+
+
+def test_hf_parity_phi3(tmp_path, _hf_env):
+    """phi3: llama-shaped with packed qkv_proj / gate_up_proj tensors
+    (the loader splits them)."""
+    transformers = pytest.importorskip("transformers")
+    c = transformers.Phi3Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, pad_token_id=0, torch_dtype="float32",
+    )
+    model = transformers.Phi3ForCausalLM._from_config(
+        c, attn_implementation="eager"
+    )
+    _parity_check(tmp_path, model, c, atol=5e-3)
