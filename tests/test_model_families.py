@@ -329,7 +329,7 @@ def test_hf_parity_gemma(tmp_path, _hf_env):
 @pytest.mark.parametrize(
     "preset",
     ["tiny", "tiny-qwen2", "tiny-qwen3", "tiny-moe", "tiny-shared-moe",
-     "tiny-gemma"]
+     "tiny-gemma", "tiny-gemma2"]
 )
 async def test_engine_serves_every_family(preset):
     """Engine e2e per family: greedy decode through the full continuous-
@@ -343,7 +343,14 @@ async def test_engine_serves_every_family(preset):
     from dynamo_exp_tpu.parallel import single_device_mesh
     from dynamo_exp_tpu.protocols.common import BackendInput
 
-    if preset == "tiny-shared-moe":  # qwen2_moe: shared expert + gate
+    if preset == "tiny-gemma2":  # softcaps + alternating sliding window
+        mcfg = dataclasses.replace(
+            PRESETS["tiny"], hidden_act="gelu_tanh", rms_norm_offset=True,
+            scale_embeddings=True, post_norms=True, attn_logit_softcap=50.0,
+            final_logit_softcap=30.0, query_pre_attn_scalar=16.0,
+            sliding_window=6, alt_sliding_window=True, model_type="gemma2",
+        )
+    elif preset == "tiny-shared-moe":  # qwen2_moe: shared expert + gate
         mcfg = dataclasses.replace(
             PRESETS["tiny-moe"], shared_expert_intermediate_size=80,
             norm_topk_prob=False, model_type="qwen2_moe",
