@@ -128,6 +128,8 @@ class Backend:
                     yield LLMEngineOutput(
                         token_ids=out.token_ids,
                         text="".join(text_parts) or None,
+                        logprobs=out.logprobs,
+                        top_logprobs=out.top_logprobs,
                         finish_reason=finished,
                         prompt_tokens=prompt_tokens if finished else None,
                         completion_tokens=emitted if finished else None,

@@ -52,7 +52,7 @@ from ..models.llama import (
     kv_cache_shardings,
     param_shardings,
 )
-from ..ops.sampling import apply_penalties, sample_tokens
+from ..ops.sampling import apply_penalties, sample_tokens, token_logprobs
 from ..parallel.mesh import build_mesh
 from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
@@ -213,7 +213,9 @@ class TPUEngine(AsyncEngine):
                 impl = "xla"
         return impl, interpret
 
-    def _decode_fn(self, attn_pages: int | None, full_sampler: bool):
+    def _decode_fn(
+        self, attn_pages: int | None, full_sampler: bool, want_lp: bool
+    ):
         """One compiled decode *window*: ``decode_window`` steps run
         on-device under ``lax.scan`` with sampled tokens fed straight
         back — the host syncs once per window instead of once per token,
@@ -236,7 +238,7 @@ class TPUEngine(AsyncEngine):
         ):
             impl = "xla"
         pages = None if impl == "pallas" else attn_pages
-        key = (impl, pages, full_sampler)
+        key = (impl, pages, full_sampler, want_lp)
         fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
@@ -264,6 +266,13 @@ class TPUEngine(AsyncEngine):
                 else:
                     rng2 = rng
                     next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # OpenAI logprobs: of the MODEL distribution (raw
+                # logits, pre-penalty/temperature), chosen + top-k.
+                # Compiled only into the want_lp variant — the common
+                # no-logprobs workload pays neither the full-vocab
+                # log_softmax nor the extra per-window host transfer.
+                if want_lp:
+                    lp, top_ids, top_lp = token_logprobs(logits, next_tok)
                 active = positions >= 0
                 counts = counts.at[
                     jnp.arange(counts.shape[0]), next_tok
@@ -275,18 +284,27 @@ class TPUEngine(AsyncEngine):
                 positions = jnp.where(
                     active & (positions < max_pos), positions + 1, -1
                 )
-                return (tokens, positions, k, v, rng2, counts), next_tok
+                ys = (
+                    (next_tok, lp, top_ids, top_lp)
+                    if want_lp
+                    else (next_tok,)
+                )
+                return (tokens, positions, k, v, rng2, counts), ys
 
-            (_, _, k, v, rng, counts), toks = jax.lax.scan(
+            (_, _, k, v, rng, counts), ys = jax.lax.scan(
                 step, (tokens, positions, k, v, rng, counts), None, length=K
             )
-            return toks, k, v, rng, counts  # toks: [K, B]
+            # ys: toks [K,B] (+ lp [K,B], top_ids/top_lp [K,B,N] when
+            # want_lp).
+            return ys, k, v, rng, counts
 
         self._decode_fns[key] = decode_window
         return decode_window
 
-    def _prefill_fn(self, rows: int, bucket: int, attn_pages: int):
-        key = (rows, bucket, attn_pages)
+    def _prefill_fn(
+        self, rows: int, bucket: int, attn_pages: int, want_lp: bool
+    ):
+        key = (rows, bucket, attn_pages, want_lp)
         fn = self._prefill_fns.get(key)
         if fn is not None:
             return fn
@@ -301,7 +319,10 @@ class TPUEngine(AsyncEngine):
             )
             rng, sub = jax.random.split(rng)
             toks = sample_tokens(logits[:, 0], sub, temp, top_k, top_p)
-            return toks, k, v, rng
+            if want_lp:
+                lp, top_ids, top_lp = token_logprobs(logits[:, 0], toks)
+                return (toks, lp, top_ids, top_lp), k, v, rng
+            return (toks,), k, v, rng
 
         self._prefill_fns[key] = prefill_step
         return prefill_step
@@ -348,8 +369,14 @@ class TPUEngine(AsyncEngine):
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
 
-        def emit(tokens: list[int], reason: FinishReason | None) -> None:
-            loop.call_soon_threadsafe(out_q.put_nowait, (tokens, reason))
+        def emit(
+            tokens: list[int],
+            reason: FinishReason | None,
+            logprobs=None,  # (lps: list[float], tops: list[dict]) | None
+        ) -> None:
+            loop.call_soon_threadsafe(
+                out_q.put_nowait, (tokens, reason, logprobs)
+            )
 
         seq = Sequence(
             request_id=ctx.id,
@@ -366,10 +393,14 @@ class TPUEngine(AsyncEngine):
         async def _gen() -> AsyncIterator[dict]:
             completion = 0
             while True:
-                tokens, reason = await out_q.get()
+                tokens, reason, logprobs = await out_q.get()
                 if tokens:
                     completion += len(tokens)
-                    yield LLMEngineOutput(token_ids=tokens).to_dict()
+                    yield LLMEngineOutput(
+                        token_ids=tokens,
+                        logprobs=logprobs[0] if logprobs else None,
+                        top_logprobs=logprobs[1] if logprobs else None,
+                    ).to_dict()
                 if reason is not None:
                     yield LLMEngineOutput(
                         finish_reason=reason,
@@ -514,10 +545,32 @@ class TPUEngine(AsyncEngine):
             )
         seq.pending_uploads = []
 
-    def _finish_first_token(self, seq: Sequence, token: int) -> None:
+    @staticmethod
+    def _wants_logprobs(seq: Sequence) -> int | None:
+        """The request's top_logprobs count (0 = chosen only), or None."""
+        return seq.stop.sampling_options.logprobs
+
+    @staticmethod
+    def _lp_pack(n_top: int, lps, top_ids, top_lps):
+        """Host-side logprob payload for emit: per-token chosen logprob
+        plus the top-n alternatives (n sliced from the static TOP_LOGPROBS
+        the device computes)."""
+        tops = None
+        if n_top > 0:
+            tops = [
+                {int(t): float(l) for t, l in zip(tid[:n_top], tlp[:n_top])}
+                for tid, tlp in zip(top_ids, top_lps)
+            ]
+        return ([float(x) for x in lps], tops)
+
+    def _finish_first_token(
+        self, seq: Sequence, token: int, lp_pack=None
+    ) -> None:
         """Shared tail of the two admission paths (computed prefill or
         remote-KV injection): record + announce the first sampled token
-        and promote the sequence to decode."""
+        and promote the sequence to decode. ``lp_pack`` is None on the
+        remote-KV path — the first token was sampled on the prefill
+        worker, which doesn't ship its distribution."""
         seq.state = SeqState.ACTIVE
         self._counts = self._init_row(self._counts, seq.slot, token)
         seq.tokens.append(token)
@@ -526,7 +579,7 @@ class TPUEngine(AsyncEngine):
         if seq.extract_cb is not None:
             seq.extract_cb(token, self._extract_prompt_pages(seq))
         reason = self.sched.check_stop(seq, token)
-        seq.emit([token], None)
+        seq.emit([token], None, lp_pack)
         if reason is not None:
             self.sched.finish(seq, reason)
 
@@ -604,8 +657,11 @@ class TPUEngine(AsyncEngine):
         attn_pages = cfg.page_bucket_for(
             max((s.prefill_sent + ps - 1) // ps for s in batch)
         )
-        fn = self._prefill_fn(rows, bucket, attn_pages)
-        toks, self.k_cache, self.v_cache, self._rng = fn(
+        want_lp = any(
+            self._wants_logprobs(seq) is not None for seq in batch
+        )
+        fn = self._prefill_fn(rows, bucket, attn_pages, want_lp)
+        ys, self.k_cache, self.v_cache, self._rng = fn(
             self.params,
             self.k_cache,
             self.v_cache,
@@ -619,9 +675,21 @@ class TPUEngine(AsyncEngine):
             jnp.asarray(top_p),
         )
         if completed:
-            sampled = np.asarray(toks)
+            if want_lp:
+                toks, lps, top_ids, top_lps = (np.asarray(y) for y in ys)
+            else:
+                toks = np.asarray(ys[0])
             for i, seq in completed:
-                self._finish_first_token(seq, int(sampled[i]))
+                n_top = self._wants_logprobs(seq)
+                pack = (
+                    self._lp_pack(
+                        n_top, lps[i : i + 1],
+                        top_ids[i : i + 1], top_lps[i : i + 1],
+                    )
+                    if want_lp and n_top is not None
+                    else None
+                )
+                self._finish_first_token(seq, int(toks[i]), pack)
 
     # ----------------------------------------------------------------- decode
     def _run_decode(self) -> bool:
@@ -678,8 +746,13 @@ class TPUEngine(AsyncEngine):
         if not stepped:
             return False
 
-        fn = self._decode_fn(cfg.page_bucket_for(max_pages), full_sampler)
-        toks, self.k_cache, self.v_cache, self._rng, self._counts = fn(
+        want_lp = any(
+            self._wants_logprobs(seq) is not None for seq, _ in stepped
+        )
+        fn = self._decode_fn(
+            cfg.page_bucket_for(max_pages), full_sampler, want_lp
+        )
+        ys, self.k_cache, self.v_cache, self._rng, self._counts = fn(
             self.params,
             self.k_cache,
             self.v_cache,
@@ -697,7 +770,11 @@ class TPUEngine(AsyncEngine):
             jnp.asarray(rep),
         )
         self.steps += K
-        sampled = np.asarray(toks)  # [K, B] — the window's single sync
+        # [K, B] (+ [K, B, N] tops when want_lp) — one sync per window.
+        if want_lp:
+            sampled, lps, top_ids, top_lps = (np.asarray(y) for y in ys)
+        else:
+            sampled = np.asarray(ys[0])
         for seq, n_valid in stepped:
             kept: list[int] = []
             reason = None
@@ -710,7 +787,17 @@ class TPUEngine(AsyncEngine):
                 if reason is not None:
                     break
             self.sched.register_full_pages(seq)
-            seq.emit(kept, None)
+            n_top = self._wants_logprobs(seq)
+            pack = None
+            if n_top is not None and kept:
+                n = len(kept)
+                pack = self._lp_pack(
+                    n_top,
+                    lps[:n, seq.slot],
+                    top_ids[:n, seq.slot],
+                    top_lps[:n, seq.slot],
+                )
+            seq.emit(kept, None, pack)
             if reason is not None:
                 self.sched.finish(seq, reason)
         return True

@@ -57,7 +57,9 @@ class Sequence:
     request_id: str
     prompt: list[int]
     stop: "BackendInput"
-    emit: Callable[[list[int], FinishReason | None], None]
+    # emit(tokens, finish_reason, logprobs_pack=None) — the third arg is
+    # the optional (per-token logprobs, top alternatives) payload.
+    emit: Callable[..., None]
     is_cancelled: Callable[[], bool]
     state: SeqState = SeqState.WAITING
     slot: int = -1

@@ -24,7 +24,7 @@ from ..protocols.openai import (
     ModelInfo,
     ModelList,
 )
-from ..preprocessor.preprocessor import PromptTooLongError
+from ..preprocessor.preprocessor import InvalidRequestError, PromptTooLongError
 from ..protocols.sse import encode_done, encode_frame
 from ..runtime.annotated import Annotated
 from ..runtime.engine import AsyncEngine, AsyncEngineContext
@@ -186,6 +186,9 @@ class HttpService:
             except PromptTooLongError as e:
                 tracker.status = "rejected"
                 return _error_response(400, str(e), err_type="context_length_exceeded")
+            except InvalidRequestError as e:
+                tracker.status = "rejected"
+                return _error_response(400, str(e), err_type="invalid_request_error")
             except Exception as e:
                 logger.exception("engine rejected request")
                 tracker.status = "error"

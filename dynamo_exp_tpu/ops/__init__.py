@@ -1,7 +1,7 @@
 from .attention import dense_causal_attention, paged_attention, write_kv_pages
 from .paged_decode import paged_decode_attention
 from .rope import apply_rope, rope_frequencies
-from .sampling import apply_penalties, sample_tokens
+from .sampling import apply_penalties, sample_tokens, token_logprobs
 
 __all__ = [
     "paged_attention",
@@ -11,5 +11,6 @@ __all__ = [
     "apply_rope",
     "rope_frequencies",
     "sample_tokens",
+    "token_logprobs",
     "apply_penalties",
 ]

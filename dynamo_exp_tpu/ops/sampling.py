@@ -75,3 +75,23 @@ def apply_penalties(
         seen, jnp.where(logits > 0, logits / rep, logits * rep), logits
     )
     return logits
+
+
+# Top-N alternatives reported alongside every chosen-token logprob; the
+# host slices down to each request's top_logprobs (OpenAI caps at 20,
+# but 5 covers the common ask without widening the per-window sync).
+TOP_LOGPROBS = 5
+
+
+def token_logprobs(
+    logits: jnp.ndarray,  # [B, V] raw model logits
+    chosen: jnp.ndarray,  # [B] int32 sampled token ids
+    top_n: int = TOP_LOGPROBS,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(chosen logprob [B], top-N ids [B, N], top-N logprobs [B, N]) of
+    the model distribution (pre-penalty/temperature), matching OpenAI's
+    logprobs semantics."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen_lp = jnp.take_along_axis(lp, chosen[:, None], axis=-1)[:, 0]
+    top_lp, top_ids = jax.lax.top_k(lp, top_n)
+    return chosen_lp, top_ids.astype(jnp.int32), top_lp

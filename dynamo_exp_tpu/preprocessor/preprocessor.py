@@ -21,6 +21,10 @@ from ..tokenizer import Tokenizer
 from .prompt import PromptFormatter
 
 
+class InvalidRequestError(ValueError):
+    """Request parameters outside supported bounds (HTTP 400)."""
+
+
 class PromptTooLongError(ValueError):
     """Prompt exceeds the model's context window (HTTP layer maps to 400)."""
 
@@ -66,6 +70,17 @@ class OpenAIPreprocessor(Operator):
                 f"prompt is {len(token_ids)} tokens but the model's context "
                 f"length is {self.mdc.context_length}"
             )
+        sampling = request.extract_sampling_options()
+        if sampling.logprobs is not None:
+            from ..ops.sampling import TOP_LOGPROBS
+
+            if sampling.logprobs > TOP_LOGPROBS:
+                # The device computes a static top-N per step; reject
+                # rather than silently truncate the client's ask.
+                raise InvalidRequestError(
+                    f"top_logprobs={sampling.logprobs} exceeds the "
+                    f"supported maximum of {TOP_LOGPROBS}"
+                )
         stop = request.extract_stop_conditions()
         if not stop.stop_token_ids:
             stop.stop_token_ids = list(
@@ -76,7 +91,7 @@ class OpenAIPreprocessor(Operator):
         return BackendInput(
             token_ids=token_ids,
             stop_conditions=stop,
-            sampling_options=request.extract_sampling_options(),
+            sampling_options=sampling,
             annotations=request.annotations(),
         )
 
@@ -109,18 +124,73 @@ class OpenAIPreprocessor(Operator):
         )
         prompt_tokens = len(backend_input.token_ids)
 
+        want_logprobs = backend_input.sampling_options.logprobs is not None
+
+        def _token_entry(tid: int, lp: float, tops: dict | None) -> dict:
+            text = self.tokenizer.decode([tid])
+            entry: dict = {
+                "token": text,
+                "logprob": lp,
+                "bytes": list(text.encode("utf-8")),
+            }
+            if is_chat:
+                entry["top_logprobs"] = [
+                    {
+                        "token": (t := self.tokenizer.decode([a])),
+                        "logprob": alp,
+                        "bytes": list(t.encode("utf-8")),
+                    }
+                    for a, alp in (tops or {}).items()
+                ]
+            return entry
+
+        def _shape(raw: list) -> dict | None:
+            """(tid, lp, tops) tuples → the OpenAI logprobs object."""
+            if not raw:
+                return None
+            entries = [_token_entry(tid, lp, tp) for tid, lp, tp in raw]
+            if is_chat:
+                return {"content": entries}
+            # Legacy completions shape.
+            has_tops = any(tp for _, _, tp in raw)
+            return {
+                "tokens": [e["token"] for e in entries],
+                "token_logprobs": [e["logprob"] for e in entries],
+                "top_logprobs": [
+                    {
+                        self.tokenizer.decode([a]): alp
+                        for a, alp in (tp or {}).items()
+                    }
+                    for _, _, tp in raw
+                ]
+                if has_tops
+                else None,
+            }
+
         async def _chunks() -> AsyncIterator[Any]:
             completion_tokens = 0
             finish: FinishReason | None = None
+            # Logprob entries buffered until text flushes: a frame's
+            # text may be withheld (partial UTF-8 in the detokenizer,
+            # possible stop-sequence prefix in the jail) while its
+            # tokens already produced logprobs — those entries ride the
+            # NEXT emitted chunk instead of being dropped.
+            pending: list = []
             async for item in stream:
                 out = (
                     LLMEngineOutput.from_dict(item) if isinstance(item, dict) else item
                 )
                 completion_tokens += len(out.token_ids)
+                if want_logprobs and out.logprobs:
+                    tops = out.top_logprobs or [None] * len(out.logprobs)
+                    pending += list(zip(out.token_ids, out.logprobs, tops))
                 if out.text:
-                    yield gen.text_chunk(out.text)
+                    yield gen.text_chunk(out.text, _shape(pending))
+                    pending = []
                 if out.finish_reason is not None:
                     finish = FinishReason(out.finish_reason)
+            if pending:  # logprobs whose text never flushed (e.g. stop)
+                yield gen.text_chunk("", _shape(pending))
             yield gen.finish_chunk(finish or FinishReason.EOS)
             if want_usage:
                 yield gen.usage_chunk(prompt_tokens, completion_tokens)

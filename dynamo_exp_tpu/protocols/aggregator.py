@@ -27,6 +27,7 @@ async def aggregate_chat_stream(
     pieces: dict[int, list[str]] = {}
     finish: dict[int, str | None] = {}
     roles: dict[int, str] = {}
+    lp_content: dict[int, list] = {}
     usage: Usage | None = None
     meta: ChatCompletionChunk | None = None
     async for chunk in chunks:
@@ -39,6 +40,10 @@ async def aggregate_chat_stream(
                 roles[idx] = choice.delta.role
             if choice.delta.content:
                 pieces.setdefault(idx, []).append(choice.delta.content)
+            if choice.logprobs and choice.logprobs.get("content"):
+                lp_content.setdefault(idx, []).extend(
+                    choice.logprobs["content"]
+                )
             if choice.finish_reason is not None:
                 finish[idx] = choice.finish_reason
     if meta is None:
@@ -51,6 +56,9 @@ async def aggregate_chat_stream(
                 role=roles.get(i, "assistant"), content="".join(pieces.get(i, []))
             ),
             finish_reason=finish.get(i),
+            logprobs=(
+                {"content": lp_content[i]} if i in lp_content else None
+            ),
         )
         for i in indices
     ]
@@ -68,6 +76,7 @@ async def aggregate_completion_stream(
 ) -> CompletionResponse:
     pieces: dict[int, list[str]] = {}
     finish: dict[int, str | None] = {}
+    lp_merge: dict[int, dict] = {}
     usage: Usage | None = None
     meta: CompletionChunk | None = None
     async for chunk in chunks:
@@ -77,6 +86,16 @@ async def aggregate_completion_stream(
         for choice in chunk.choices:
             if choice.text:
                 pieces.setdefault(choice.index, []).append(choice.text)
+            if choice.logprobs:
+                agg = lp_merge.setdefault(
+                    choice.index,
+                    {"tokens": [], "token_logprobs": [], "top_logprobs": []},
+                )
+                agg["tokens"] += choice.logprobs.get("tokens") or []
+                agg["token_logprobs"] += (
+                    choice.logprobs.get("token_logprobs") or []
+                )
+                agg["top_logprobs"] += choice.logprobs.get("top_logprobs") or []
             if choice.finish_reason is not None:
                 finish[choice.index] = choice.finish_reason
     if meta is None:
@@ -84,7 +103,10 @@ async def aggregate_completion_stream(
     indices = sorted(set(pieces) | set(finish)) or [0]
     choices = [
         CompletionChoice(
-            index=i, text="".join(pieces.get(i, [])), finish_reason=finish.get(i)
+            index=i,
+            text="".join(pieces.get(i, [])),
+            finish_reason=finish.get(i),
+            logprobs=lp_merge.get(i),
         )
         for i in indices
     ]

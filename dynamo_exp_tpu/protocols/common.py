@@ -85,6 +85,11 @@ class LLMEngineOutput(BaseModel):
     # Engines that do their own detokenization may set text directly.
     text: str | None = None
     cum_log_probs: float | None = None
+    # Per-token logprobs, aligned with token_ids (present only when the
+    # request asked): chosen-token logprob, and the top-N alternatives
+    # as {token_id: logprob} (N = the request's top_logprobs).
+    logprobs: list[float] | None = None
+    top_logprobs: list[dict[int, float]] | None = None
     finish_reason: FinishReason | None = None
     # Usage accounting, set on the final frame.
     prompt_tokens: int | None = None

@@ -42,7 +42,7 @@ class ChatDeltaGenerator:
             ],
         )
 
-    def text_chunk(self, text: str) -> ChatCompletionChunk:
+    def text_chunk(self, text: str, logprobs=None) -> ChatCompletionChunk:
         delta = ChatChoiceDelta(content=text)
         if not self._sent_role:
             delta.role = "assistant"
@@ -51,7 +51,11 @@ class ChatDeltaGenerator:
             id=self.id,
             created=self.created,
             model=self.model,
-            choices=[ChatStreamChoice(index=self.index, delta=delta)],
+            choices=[
+                ChatStreamChoice(
+                    index=self.index, delta=delta, logprobs=logprobs
+                )
+            ],
         )
 
     def finish_chunk(self, reason: FinishReason) -> ChatCompletionChunk:
@@ -91,12 +95,16 @@ class CompletionDeltaGenerator:
         self.created = now_unix()
         self.index = index
 
-    def text_chunk(self, text: str) -> CompletionChunk:
+    def text_chunk(self, text: str, logprobs=None) -> CompletionChunk:
         return CompletionChunk(
             id=self.id,
             created=self.created,
             model=self.model,
-            choices=[CompletionChoice(index=self.index, text=text)],
+            choices=[
+                CompletionChoice(
+                    index=self.index, text=text, logprobs=logprobs
+                )
+            ],
         )
 
     def finish_chunk(self, reason: FinishReason) -> CompletionChunk:

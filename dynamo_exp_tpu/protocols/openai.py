@@ -100,7 +100,9 @@ class ChatCompletionRequest(BaseModel):
             presence_penalty=self.presence_penalty,
             repetition_penalty=self.repetition_penalty,
             seed=self.seed,
-            logprobs=self.top_logprobs if self.logprobs else None,
+            # logprobs=true alone means "chosen-token logprob only"
+            # (top_logprobs=0), not "no logprobs".
+            logprobs=(self.top_logprobs or 0) if self.logprobs else None,
         )
 
     def annotations(self) -> list[str]:
