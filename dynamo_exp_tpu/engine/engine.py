@@ -441,7 +441,9 @@ class TPUEngine(AsyncEngine):
                 lambda: fut.done() or fut.set_result((token, pages))
             )
 
-        def emit(tokens: list[int], reason: FinishReason | None) -> None:
+        def emit(
+            tokens: list[int], reason: FinishReason | None, logprobs=None
+        ) -> None:
             if reason in (FinishReason.ERROR, FinishReason.CANCELLED):
                 loop.call_soon_threadsafe(
                     lambda: fut.done()

@@ -14,6 +14,24 @@ import os
 from dataclasses import dataclass, field
 
 
+def _layer_types_of(cfg: dict, model_type: str) -> tuple[str, ...] | None:
+    """Per-layer attention kinds. Older gemma3 configs ship only
+    ``sliding_window_pattern`` (every Nth layer is full attention, HF:
+    ``is_sliding = bool((layer_idx + 1) % pattern)``) — derive the
+    explicit list rather than silently treating every layer as sliding
+    (which would also rope the full layers at the local base)."""
+    explicit = cfg.get("layer_types")
+    if explicit:
+        return tuple(explicit)
+    pattern = cfg.get("sliding_window_pattern")
+    if model_type == "gemma3_text" and pattern:
+        return tuple(
+            "sliding_attention" if (i + 1) % pattern else "full_attention"
+            for i in range(cfg.get("num_hidden_layers", 32))
+        )
+    return None
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     """Decoder-transformer architecture hyperparameters. One config class
@@ -57,6 +75,11 @@ class ModelConfig:
     final_logit_softcap: float | None = None
     query_pre_attn_scalar: float | None = None
     alt_sliding_window: bool = False
+    # gemma3: explicit per-layer attention kinds ("sliding_attention" /
+    # "full_attention", 5:1 pattern) and a separate rope base for the
+    # sliding layers (full layers use rope_theta + rope_scaling).
+    layer_types: tuple[str, ...] | None = None
+    rope_local_base_freq: float | None = None
     # Mistral: keys older than (q_pos - sliding_window + 1) are masked.
     # None = full causal attention.
     sliding_window: int | None = None
@@ -126,7 +149,7 @@ class ModelConfig:
             attention_bias=cfg.get(
                 "attention_bias", model_type in ("qwen2", "qwen2_moe")
             ),
-            qk_norm=model_type in ("qwen3", "qwen3_moe"),
+            qk_norm=model_type in ("qwen3", "qwen3_moe", "gemma3_text"),
             hidden_act=(
                 "gelu_tanh"
                 if str(
@@ -135,13 +158,15 @@ class ModelConfig:
                 ).startswith("gelu")
                 else "silu"
             ),
-            rms_norm_offset=model_type in ("gemma", "gemma2"),
-            scale_embeddings=model_type in ("gemma", "gemma2"),
-            post_norms=model_type == "gemma2",
+            rms_norm_offset=model_type in ("gemma", "gemma2", "gemma3_text"),
+            scale_embeddings=model_type in ("gemma", "gemma2", "gemma3_text"),
+            post_norms=model_type in ("gemma2", "gemma3_text"),
             attn_logit_softcap=cfg.get("attn_logit_softcapping"),
             final_logit_softcap=cfg.get("final_logit_softcapping"),
             query_pre_attn_scalar=cfg.get("query_pre_attn_scalar"),
             alt_sliding_window=model_type == "gemma2",
+            layer_types=_layer_types_of(cfg, model_type),
+            rope_local_base_freq=cfg.get("rope_local_base_freq"),
             # qwen2 ships a sliding_window value with
             # use_sliding_window=false — honour the switch, or every
             # HF-loaded qwen2 would lose the Pallas decode path and

@@ -240,9 +240,10 @@ def _attn_mlp_layer(
     q = q.reshape(B, T, lp["wq"].shape[-1] // hd, hd)
     k = k.reshape(B, T, lp["wk"].shape[-1] // hd, hd)
     v = v.reshape(B, T, lp["wv"].shape[-1] // hd, hd)
-    if "q_norm" in lp:  # qwen3: per-head RMSNorm before rope
-        q = rms_norm(q, lp["q_norm"], eps)
-        k = rms_norm(k, lp["k_norm"], eps)
+    if "q_norm" in lp:  # qwen3/gemma3: per-head RMSNorm before rope
+        # (gemma3 stores these gemma-style: scale = 1 + w)
+        q = rms_norm(q, lp["q_norm"], eps, off)
+        k = rms_norm(k, lp["k_norm"], eps, off)
     q = apply_rope(q, rope_pos, inv_freq)
     k = apply_rope(k, rope_pos, inv_freq)
     attn, kv_extra = attend(q, k, v)
@@ -407,21 +408,36 @@ def forward(
         else None
     )
     # Per-layer window widths ride the scan (gemma2 alternates sliding
-    # and full layers; mistral uses one width everywhere). 1<<30 ≈ no
-    # window for the full-attention layers.
+    # and full layers; gemma3 follows its explicit layer_types; mistral
+    # uses one width everywhere). 1<<30 ≈ no window for full layers.
     have_window = cfg.sliding_window is not None
+    if cfg.layer_types:
+        sliding = [t == "sliding_attention" for t in cfg.layer_types]
+    else:
+        sliding = [
+            not cfg.alt_sliding_window or i % 2 == 0
+            for i in range(cfg.num_layers)
+        ]
     win_arr = jnp.asarray(
         [
-            cfg.sliding_window
-            if (have_window and (not cfg.alt_sliding_window or i % 2 == 0))
-            else 1 << 30
+            cfg.sliding_window if (have_window and sliding[i]) else 1 << 30
             for i in range(cfg.num_layers)
         ],
         jnp.int32,
     )
+    # gemma3: sliding layers rope at a separate (local) base; full
+    # layers use rope_theta + rope_scaling. Per-layer inv_freq rides
+    # the scan alongside the window widths.
+    if cfg.rope_local_base_freq is not None:
+        invf_local = rope_frequencies(hd, cfg.rope_local_base_freq)
+        invf_arr = jnp.stack(
+            [invf_local if s else inv_freq for s in sliding]
+        )
+    else:
+        invf_arr = jnp.tile(inv_freq[None], (cfg.num_layers, 1))
 
     def layer(x, layer_in):
-        lp, k_pool, v_pool, win_l = layer_in
+        lp, k_pool, v_pool, win_l, invf_l = layer_in
 
         def attend(q, k, v):
             kp, vp = write_kv_pages(
@@ -456,11 +472,11 @@ def forward(
             )
 
         return _attn_mlp_layer(
-            x, lp, cfg, inv_freq, rope_pos, eps, attend, mesh=mesh
+            x, lp, cfg, invf_l, rope_pos, eps, attend, mesh=mesh
         )
 
     x, (new_k, new_v) = jax.lax.scan(
-        layer, x, (params["layers"], k_cache, v_cache, win_arr)
+        layer, x, (params["layers"], k_cache, v_cache, win_arr, invf_arr)
     )
     if last_positions is not None:
         x = jnp.take_along_axis(x, last_positions[:, None, None], axis=1)

@@ -38,7 +38,8 @@ def _open_safetensors(path: str):
 
 
 SUPPORTED_MODEL_TYPES = (
-    "llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2", "phi3",
+    "llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2",
+    "gemma3_text", "phi3",
     "mixtral", "qwen2_moe", "qwen3_moe",
 )
 

@@ -101,6 +101,9 @@ async def aggregate_completion_stream(
     if meta is None:
         raise ValueError("empty response stream")
     indices = sorted(set(pieces) | set(finish)) or [0]
+    for agg in lp_merge.values():
+        if not agg["top_logprobs"]:  # logprobs=0: null, not [] (OpenAI)
+            agg["top_logprobs"] = None
     choices = [
         CompletionChoice(
             index=i,

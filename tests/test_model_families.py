@@ -447,3 +447,38 @@ def test_hf_parity_phi3(tmp_path, _hf_env):
         c, attn_implementation="eager"
     )
     _parity_check(tmp_path, model, c, atol=5e-3)
+
+
+def test_hf_parity_gemma3(tmp_path, _hf_env):
+    """gemma3 text: explicit layer_types (sliding/full), dual rope base
+    (local 10k on sliding layers, rope_theta on full), q/k norm, 4-norm
+    layers, no softcaps."""
+    transformers = pytest.importorskip("transformers")
+    c = transformers.Gemma3TextConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=128, sliding_window=6,
+        sliding_window_pattern=2, rope_theta=1000000.0,
+        rope_local_base_freq=10000.0, query_pre_attn_scalar=8,
+        torch_dtype="float32",
+    )
+    model = transformers.Gemma3ForCausalLM._from_config(
+        c, attn_implementation="eager"
+    )
+    # Slightly looser: four offset-norms per layer in float32 accumulate
+    # more ordering noise than the other families.
+    _parity_check(tmp_path, model, c, n_tokens=16, atol=8e-3)
+
+
+def test_gemma3_layer_types_from_pattern():
+    """Older gemma3 configs with only sliding_window_pattern derive the
+    explicit layer kinds (every Nth layer full attention)."""
+    cfg = ModelConfig.from_hf_config({
+        "model_type": "gemma3_text", "num_hidden_layers": 6,
+        "sliding_window": 512, "sliding_window_pattern": 3,
+        "rope_local_base_freq": 10000.0,
+    })
+    assert cfg.layer_types == (
+        "sliding_attention", "sliding_attention", "full_attention",
+        "sliding_attention", "sliding_attention", "full_attention",
+    )
