@@ -246,9 +246,24 @@ class KvPageReceiver:
                     )
                 fut.set_result((first_token, pages))
             else:
-                # Single-frame form (legacy senders).
-                pages = decode_pages(msg.header, msg.payload)
-                fut.set_result((msg.header["first_token"], pages))
+                # Unchunked single-frame transfers are rejected outright:
+                # one frame would buffer the whole KV payload (hundreds of
+                # MB at long ISL) in receiver memory, defeating the
+                # chunked/windowed bound. A sender speaking the old shape
+                # must fail visibly, not degrade silently.
+                err = (
+                    "unchunked KV transfer frame rejected (sender too "
+                    "old: expected begin/data/end chunk protocol)"
+                )
+                fut.set_exception(RuntimeError(err))
+                # The sender treats the final ack as proof of delivery
+                # before releasing its device pages — it must see the
+                # failure, not ok=True.
+                await write_message(
+                    writer,
+                    TwoPartMessage(MsgType.COMPLETE, {"ok": False, "error": err}),
+                )
+                return
             await write_message(writer, TwoPartMessage(MsgType.COMPLETE, {"ok": True}))
         except (asyncio.IncompleteReadError, ConnectionError) as e:
             # A connection drop mid-transfer must fail the waiting

@@ -182,6 +182,39 @@ async def test_receiver_delivery_and_error():
         await recv.close()
 
 
+async def test_receiver_rejects_unchunked_single_frame():
+    """The old single-frame shape (no begin/data/end) would buffer the
+    whole KV payload in one frame; the receiver must fail it visibly."""
+    from dynamo_exp_tpu.runtime.transports.codec import (
+        MsgType,
+        TwoPartMessage,
+        write_message,
+    )
+
+    recv = KvPageReceiver()
+    await recv.start()
+    try:
+        fut = recv.expect("r-legacy")
+        host, port = recv.address.rsplit(":", 1)
+        _, writer = await asyncio.open_connection(host, port)
+        try:
+            pages = [
+                (np.ones((1, 2, 1, 2), np.float32), np.zeros((1, 2, 1, 2), np.float32))
+            ]
+            header, payload = encode_pages(pages)
+            header.update({"request_id": "r-legacy", "first_token": 7})
+            # Deliberately no "kind": the pre-chunking wire shape.
+            await write_message(
+                writer, TwoPartMessage(MsgType.FRAME, header, payload)
+            )
+            with pytest.raises(RuntimeError, match="unchunked"):
+                await asyncio.wait_for(fut, 5)
+        finally:
+            writer.close()
+    finally:
+        await recv.close()
+
+
 # ----------------------------------------------------------------------- e2e
 def make_engine(**kw) -> TPUEngine:
     cfg = EngineConfig(
