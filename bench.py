@@ -86,15 +86,18 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
     engine.start()
 
     rs = np.random.RandomState(0)
-    # Distinct warmup/timed prompt sets: identical shapes hit the same
-    # compiled variants, distinct tokens keep the prefix cache honest.
-    prompts, warmups = (
-        [
+
+    # Fresh tokens for every burst: identical shapes hit the same
+    # compiled variants, distinct tokens keep the prefix cache honest
+    # (re-serving a previous burst's prompts would measure warm-cache
+    # prefill instead of steady-state decode).
+    def fresh_prompts() -> list[list[int]]:
+        return [
             rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
             for _ in range(concurrency)
         ]
-        for _ in range(2)
-    )
+
+    warmups = fresh_prompts()
 
     async def run_one(prompt):
         b = BackendInput(token_ids=prompt)
@@ -122,7 +125,7 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
         # high-variance, and peak steady-state is the honest capability
         # number a flaky link can still demonstrate.
         best = None
-        for burst_prompts in (prompts, warmups, prompts):
+        for burst_prompts in (fresh_prompts() for _ in range(3)):
             t0 = time.perf_counter()
             results = await asyncio.gather(*[run_one(p) for p in burst_prompts])
             dt = time.perf_counter() - t0

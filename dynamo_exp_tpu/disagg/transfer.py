@@ -100,6 +100,18 @@ async def send_kv_pages(
     """
     host, _, port = return_addr.rpartition(":")
     reader, writer = await asyncio.open_connection(host or "127.0.0.1", int(port))
+
+    async def _read_ack() -> None:
+        """An ack that is an ERROR frame (or ok=False) means the receiver
+        rejected the transfer — the sender must NOT treat it as delivery
+        and release its device pages."""
+        ack = await read_message(reader)
+        if ack.msg_type == MsgType.ERROR or ack.header.get("ok") is False:
+            raise RuntimeError(
+                f"KV transfer rejected by receiver: "
+                f"{ack.header.get('error', 'unknown error')}"
+            )
+
     try:
         if error is not None:
             await write_message(
@@ -133,10 +145,10 @@ async def send_kv_pages(
             )
             unacked += 1
             if unacked >= window:
-                await read_message(reader)  # per-chunk ack
+                await _read_ack()  # per-chunk ack
                 unacked -= 1
         while unacked > 0:
-            await read_message(reader)
+            await _read_ack()
             unacked -= 1
         await write_message(
             writer,
@@ -146,7 +158,7 @@ async def send_kv_pages(
         )
         # Final ack: pages are known-delivered before the prefill worker
         # releases/reuses its device pages.
-        await read_message(reader)
+        await _read_ack()
     finally:
         writer.close()
         with contextlib.suppress(Exception):
@@ -258,10 +270,11 @@ class KvPageReceiver:
                 fut.set_exception(RuntimeError(err))
                 # The sender treats the final ack as proof of delivery
                 # before releasing its device pages — it must see the
-                # failure, not ok=True.
+                # failure: an ERROR frame (checked by _read_ack) rather
+                # than an ok-shaped COMPLETE a naive sender would take
+                # as confirmation.
                 await write_message(
-                    writer,
-                    TwoPartMessage(MsgType.COMPLETE, {"ok": False, "error": err}),
+                    writer, TwoPartMessage(MsgType.ERROR, {"error": err})
                 )
                 return
             await write_message(writer, TwoPartMessage(MsgType.COMPLETE, {"ok": True}))

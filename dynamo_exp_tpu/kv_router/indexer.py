@@ -21,7 +21,7 @@ import logging
 from collections import defaultdict
 from typing import Sequence
 
-from ..tokens import compute_block_hashes_for_seq
+from ..tokens import HASH_ALGO_VERSION, compute_block_hashes_for_seq
 from .protocols import KvCacheEventData, OverlapScores, RouterEvent
 
 logger = logging.getLogger(__name__)
@@ -35,6 +35,12 @@ class RadixIndex:
         self._hashes_by_worker: dict[int, set[int]] = defaultdict(set)
 
     def apply_event(self, event: RouterEvent) -> None:
+        if event.hash_version != HASH_ALGO_VERSION:
+            # Warned once at decode (protocols.from_dict). A mismatched
+            # peer's hashes live in a disjoint seed space and can never
+            # match a local query — indexing them would only grow
+            # unmatchable state for the life of that worker.
+            return
         w = event.worker_id
         data: KvCacheEventData = event.data
         if data.kind == "stored":

@@ -7,8 +7,17 @@ Capability parity with ``/root/reference/lib/llm/src/kv_router/protocols.rs``:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Any
+
+from ..tokens import HASH_ALGO_VERSION
+
+logger = logging.getLogger(__name__)
+
+# Hash-algorithm versions we've already warned about (once per version,
+# not per event — the event plane carries thousands of these).
+_warned_hash_versions: set[int] = set()
 
 
 @dataclass
@@ -53,6 +62,7 @@ class RouterEvent:
 
     worker_id: int
     data: KvCacheEventData
+    hash_version: int = HASH_ALGO_VERSION
 
     def to_dict(self) -> dict:
         return {
@@ -60,10 +70,25 @@ class RouterEvent:
             "kind": self.data.kind,
             "block_hashes": list(self.data.block_hashes),
             "parent_hash": self.data.parent_hash,
+            "hash_version": self.hash_version,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "RouterEvent":
+        # A worker on a different block-hash algorithm produces hashes
+        # the local indexer can never match: surface the mismatch once
+        # instead of silently losing KV-aware routing mid-rollout.
+        version = int(d.get("hash_version", 1))
+        if version != HASH_ALGO_VERSION and version not in _warned_hash_versions:
+            _warned_hash_versions.add(version)
+            logger.warning(
+                "KV event from worker %s uses block-hash algorithm v%d "
+                "(local: v%d) — prefix reuse across this pair is disabled "
+                "until versions match",
+                d.get("worker_id"),
+                version,
+                HASH_ALGO_VERSION,
+            )
         return cls(
             worker_id=int(d["worker_id"]),
             data=KvCacheEventData(
@@ -71,6 +96,7 @@ class RouterEvent:
                 block_hashes=[int(h) for h in d.get("block_hashes", [])],
                 parent_hash=d.get("parent_hash"),
             ),
+            hash_version=version,
         )
 
 

@@ -180,7 +180,10 @@ class ModelConfig:
                 "num_local_experts", cfg.get("num_experts", 0)
             ) or 0,
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
-            norm_topk_prob=cfg.get("norm_topk_prob", True),
+            # HF defaults differ per family: Mixtral always renormalizes
+            # top-k router weights; Qwen2Moe/Qwen3Moe default to False
+            # when config.json omits the key.
+            norm_topk_prob=cfg.get("norm_topk_prob", model_type == "mixtral"),
             moe_intermediate_size=cfg.get("moe_intermediate_size"),
             shared_expert_intermediate_size=cfg.get(
                 "shared_expert_intermediate_size"

@@ -96,6 +96,21 @@ class OpenAIPreprocessor(Operator):
         )
 
     # --- pipeline operator --------------------------------------------
+    def _top_map(self, tops: dict | None) -> dict[str, float]:
+        """Legacy completions top_logprobs entry, keyed by decoded text.
+
+        Distinct token ids can decode to the same string (partial-UTF-8
+        byte tokens all render U+FFFD); keep the best logprob per string
+        rather than letting dict insertion order silently drop
+        alternatives.
+        """
+        out: dict[str, float] = {}
+        for tid, lp in (tops or {}).items():
+            s = self.tokenizer.decode([tid])
+            if s not in out or lp > out[s]:
+                out[s] = lp
+        return out
+
     async def generate(
         self,
         request: Any,
@@ -156,13 +171,7 @@ class OpenAIPreprocessor(Operator):
             return {
                 "tokens": [e["token"] for e in entries],
                 "token_logprobs": [e["logprob"] for e in entries],
-                "top_logprobs": [
-                    {
-                        self.tokenizer.decode([a]): alp
-                        for a, alp in (tp or {}).items()
-                    }
-                    for _, _, tp in raw
-                ]
+                "top_logprobs": [self._top_map(tp) for _, _, tp in raw]
                 if has_tops
                 else None,
             }

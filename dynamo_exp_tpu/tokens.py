@@ -17,9 +17,18 @@ from typing import Callable, Iterable, Sequence
 
 from . import native as _native
 
+# Version tag of the block-hash algorithm. Bumped whenever the hash
+# function changes (v2 = the splitmix64 chain that replaced xxh3_64);
+# mixed into the default seed so peers running different algorithm
+# versions live in disjoint hash spaces *by construction*, and carried
+# on the KV-event wire (``kv_router.protocols.RouterEvent``) so a
+# mixed-version deployment logs a visible warning instead of silently
+# losing prefix reuse until the rollout completes.
+HASH_ALGO_VERSION = 2
+
 # Salt seeds the first block's chain so that hashes from different
 # deployments/configurations don't collide by construction.
-DEFAULT_HASH_SEED = 1337
+DEFAULT_HASH_SEED = 1337 ^ (HASH_ALGO_VERSION << 32)
 
 
 def compute_block_hash(tokens: Sequence[int], seed: int = DEFAULT_HASH_SEED) -> int:

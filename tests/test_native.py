@@ -8,6 +8,7 @@ import pytest
 
 from dynamo_exp_tpu import native
 from dynamo_exp_tpu.tokens import (
+    DEFAULT_HASH_SEED,
     compute_block_hash,
     compute_block_hashes_for_seq,
     chain_hash,
@@ -37,7 +38,7 @@ def test_cpp_matches_python_mirror():
 def test_batch_seq_hashes_match_blockwise_loop():
     rs = np.random.RandomState(1)
     toks = rs.randint(0, 2**31, size=67).tolist()  # 4 full blocks of 16 + tail
-    batch = native.seq_hashes(toks, 16, 1337)
+    batch = native.seq_hashes(toks, 16, DEFAULT_HASH_SEED)
     loop = []
     parent = None
     for start in range(0, len(toks) - 15, 16):
