@@ -39,6 +39,48 @@ def pytest_configure(config):
     )
 
 
+# ---------------------------------------------------------------- test tiers
+# Reference discipline: marker tiers (pre_merge/nightly/weekly) selected by
+# CI (.github/workflows/). The full suite is ~9.5 min; CI's per-commit
+# budget wants < 2 min. Tiering is centralized here instead of per-file
+# pytestmark lines so the split is auditable in one place: a test is
+# pre_merge unless its file (or name) is listed below.
+#
+# Nightly = the wall-clock-dominant suites: HF-parity across all model
+# families, multi-process supervisors (SDK serve, CLI, multihost), and
+# the interpret-mode Pallas kernel oracle checks.
+_NIGHTLY_FILES = {
+    "test_model_families.py",  # 11-family HF logits parity, ~2.5 min
+    "test_llm_graphs.py",  # SDK graph supervisors over HTTP
+    "test_run_cli.py",  # multi-process discovery serve
+    "test_sdk.py",  # SDK supervisor lifecycle
+    "test_multihost.py",  # jax.distributed bring-up subprocesses
+    "test_paged_decode.py",  # Pallas interpret-mode vs XLA oracle
+    "test_logprobs.py",  # engine logprob oracle runs
+    "test_disagg.py",  # two-engine disagg e2e
+    "test_ring_attention.py",  # ring vs dense oracles on the 8-dev mesh
+    "test_kv_offload.py",  # host-offload round trips
+    "test_model.py",  # full-model forward oracles
+    "test_hub_gguf.py",  # GGUF write/load round trips
+    "test_planner.py",  # supervisor scale up/down under load
+}
+# Individually slow tests inside otherwise pre_merge files.
+_NIGHTLY_TESTS = {
+    "test_concurrent_requests_batch",  # 110s: full batching soak
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(m in ("nightly", "weekly", "tpu", "pre_merge") for m in item.keywords):
+            continue  # explicitly marked — leave as-is
+        name = item.function.__name__ if hasattr(item, "function") else item.name
+        if item.fspath.basename in _NIGHTLY_FILES or name in _NIGHTLY_TESTS:
+            item.add_marker(pytest.mark.nightly)
+        else:
+            item.add_marker(pytest.mark.pre_merge)
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Minimal asyncio support (pytest-asyncio is not in the image)."""
