@@ -49,13 +49,16 @@ class ModelWatcher:
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._watch())
+        self._sweep_task = asyncio.ensure_future(self._sweep_expired_cards())
 
     async def close(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            with contextlib.suppress(asyncio.CancelledError):
-                await self._task
-            self._task = None
+        for attr in ("_task", "_sweep_task"):
+            task = getattr(self, attr, None)
+            if task is not None:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+                setattr(self, attr, None)
         for r in self._kv_routers.values():
             await r.stop()
         self._kv_routers.clear()
@@ -77,6 +80,36 @@ class ModelWatcher:
             except Exception:  # noqa: BLE001 - reconnect after backoff
                 logger.exception("model watch stream broke; retrying")
                 await asyncio.sleep(1.0)
+
+    async def _sweep_expired_cards(self, period_s: float | None = None) -> None:
+        """Delete cards whose heartbeat went stale (reference: model.rs
+        expiry watcher, checked every CARD_MAX_AGE/3). The worker-side
+        purge is best-effort — two replicas closing simultaneously can
+        each skip deletion seeing the other's entry — so ingress owns
+        the authoritative sweep; ``is_expired`` at fetch time fences any
+        card a sweep hasn't reached yet."""
+        from ..model_card import CARD_MAX_AGE_S
+
+        if period_s is None:
+            period_s = CARD_MAX_AGE_S / 3
+        while True:
+            await asyncio.sleep(period_s)
+            try:
+                for key in await self.drt.object_store.list(MDC_BUCKET):
+                    raw = await self.drt.object_store.get(MDC_BUCKET, key)
+                    if raw is None:
+                        continue
+                    try:
+                        card = ModelDeploymentCard.from_json(raw.decode())
+                    except Exception:  # noqa: BLE001 - unreadable card:
+                        continue  # leave for an operator to inspect
+                    if card.is_expired():
+                        await self.drt.object_store.delete(MDC_BUCKET, key)
+                        logger.info("swept expired model card %s", key)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - retry next period
+                logger.exception("model card sweep failed")
 
     @staticmethod
     def _types_of(model_type: str) -> set[str]:
@@ -150,6 +183,15 @@ class ModelWatcher:
         if raw is None:
             raise RuntimeError(f"no MDC in object store for {entry.name}")
         mdc = ModelDeploymentCard.from_json(raw.decode())
+        if mdc.is_expired():
+            # Heartbeats re-stamp every CARD_MAX_AGE_S/3; a stale stamp
+            # means every publisher of this card is gone (the ModelEntry
+            # that led us here is a leftover about to be swept). Never
+            # build a serving chain from a dead worker's card.
+            raise RuntimeError(
+                f"model card for {entry.name} expired "
+                f"(last published {mdc.last_published})"
+            )
         addr = EndpointAddress.from_url(entry.endpoint)
         ep = (
             self.drt.namespace(addr.namespace)

@@ -16,11 +16,16 @@ deployment story, where every host has the model directory.
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import json
+import logging
 from dataclasses import asdict, dataclass
 
-from .model_card import ModelDeploymentCard
+from .model_card import CARD_MAX_AGE_S, ModelDeploymentCard
 from .runtime.component import DistributedRuntime, Endpoint
+
+logger = logging.getLogger(__name__)
 
 MDC_BUCKET = "mdc"
 MODELS_PREFIX = "models/"
@@ -57,6 +62,7 @@ async def register_llm(
     mdc = ModelDeploymentCard.from_local_path(model_path, model_name)
     if kv_cache_block_size:
         mdc.kv_cache_block_size = kv_cache_block_size
+    mdc.stamp()
     await drt.object_store.put(MDC_BUCKET, mdc.slug, mdc.to_json().encode())
     entry = ModelEntry(
         name=mdc.display_name,
@@ -70,4 +76,63 @@ async def register_llm(
     # until the last replica is gone (reference keys entries per instance).
     key = f"{MODELS_PREFIX}{mdc.slug}/{lease.lease_id}"
     await drt.discovery.kv_put(key, entry.to_bytes(), lease)
+    # Heartbeat: keep last_published fresh while this worker lives, so
+    # ingress can tell a served card from a dead worker's leftover
+    # (reference re-publishes under a 5-min TTL; model.rs:79-215). The
+    # card outliving its entries is harmless only as long as it is
+    # visibly stale-dated.
+    drt.spawn_background(
+        _mdc_heartbeat(drt, mdc, lease), name=f"mdc-heartbeat[{mdc.slug}]"
+    )
     return entry
+
+
+async def _mdc_heartbeat(
+    drt: DistributedRuntime,
+    mdc: ModelDeploymentCard,
+    lease,
+    period_s: float = CARD_MAX_AGE_S / 3,
+) -> None:
+    """Re-publish the card every ``period_s`` while the lease is valid;
+    on lease loss (or cancellation at shutdown) delete it so the bucket
+    doesn't accumulate dead workers' cards."""
+    try:
+        while lease.is_valid():
+            await asyncio.sleep(period_s)
+            if not lease.is_valid():
+                break
+            mdc.stamp()
+            try:
+                await drt.object_store.put(
+                    MDC_BUCKET, mdc.slug, mdc.to_json().encode()
+                )
+            except Exception:  # noqa: BLE001 - a coordinator hiccup must
+                # not kill the heartbeat (and thereby purge a live
+                # worker's card); retry on the next beat.
+                logger.warning(
+                    "mdc heartbeat publish failed for %s; retrying",
+                    mdc.slug,
+                    exc_info=True,
+                )
+    finally:
+        # Best-effort purge — but only when no other replica still has a
+        # live ModelEntry for this model (N replicas share one card key;
+        # the last one out removes it). Bounded: an unresponsive
+        # coordinator must not wedge worker shutdown — an unpurged card
+        # is still fenced by its TTL.
+        with contextlib.suppress(Exception):
+            remaining = await asyncio.wait_for(
+                asyncio.shield(
+                    drt.discovery.kv_get_prefix(f"{MODELS_PREFIX}{mdc.slug}/")
+                ),
+                5.0,
+            )
+            ours = f"{MODELS_PREFIX}{mdc.slug}/{lease.lease_id}"
+            if not any(k != ours for k in remaining):
+                await asyncio.wait_for(
+                    asyncio.shield(
+                        drt.object_store.delete(MDC_BUCKET, mdc.slug)
+                    ),
+                    5.0,
+                )
+                logger.info("purged model card %s", mdc.slug)

@@ -11,8 +11,14 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from dataclasses import asdict, dataclass, field
+import time
+from dataclasses import asdict, dataclass, field, fields
 from typing import Any
+
+# How stale a card's last_published may be before ingress treats it as a
+# dead worker's leftover (reference: model.rs CARD_MAX_AGE, 5-min bucket
+# TTL). Workers re-publish every CARD_MAX_AGE_S / 3 while alive.
+CARD_MAX_AGE_S = 300.0
 
 
 @dataclass
@@ -32,14 +38,38 @@ class ModelDeploymentCard:
     tokenizer_path: str = ""
     model_type: str = "chat"  # "chat" | "completion" | "backend"
     migration_limit: int = 0
+    # Publication heartbeat (reference: model.rs last_published/revision):
+    # ``None`` means never advertised (a locally built card).
+    last_published: float | None = None
+    revision: int = 0
 
     @property
     def slug(self) -> str:
         return self.display_name.replace("/", "--")
 
+    def stamp(self) -> None:
+        """Mark the card as freshly advertised (call just before put)."""
+        self.last_published = time.time()
+        self.revision += 1
+
+    def is_expired(
+        self, max_age_s: float = CARD_MAX_AGE_S, now: float | None = None
+    ) -> bool:
+        """Stale last_published ⇒ the publishing worker is likely gone.
+        Never-published cards are not expired (null-object local use)."""
+        if self.last_published is None:
+            return False
+        return (now if now is not None else time.time()) - self.last_published > max_age_s
+
     def mdcsum(self) -> str:
+        # Content checksum: publication metadata (heartbeat stamp,
+        # revision) excluded so re-advertising an unchanged card keeps
+        # the same sum.
+        d = asdict(self)
+        d.pop("last_published", None)
+        d.pop("revision", None)
         return hashlib.sha256(
-            json.dumps(asdict(self), sort_keys=True).encode()
+            json.dumps(d, sort_keys=True).encode()
         ).hexdigest()[:16]
 
     def to_json(self) -> str:
@@ -47,7 +77,11 @@ class ModelDeploymentCard:
 
     @classmethod
     def from_json(cls, text: str) -> "ModelDeploymentCard":
-        return cls(**json.loads(text))
+        # Tolerant of unknown keys so the card format can evolve without
+        # breaking not-yet-upgraded readers mid-rollout.
+        d = json.loads(text)
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
     @classmethod
     def from_local_path(

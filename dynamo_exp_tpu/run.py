@@ -321,8 +321,12 @@ async def run_worker(opts, drt, core, tpu_engine):
     finally:
         # Bounded: an unresponsive coordinator must not wedge shutdown
         # (the lease expiring cleans up registrations anyway).
-        with contextlib.suppress(asyncio.TimeoutError):
+        t0 = time.monotonic()
+        try:
             await asyncio.wait_for(served.close(), 15)
+        except asyncio.TimeoutError:
+            logger.warning("endpoint close timed out after 15s")
+        logger.info("endpoint closed in %.2fs", time.monotonic() - t0)
 
 
 def _chat_payload(model: str, prompt: str, opts) -> dict:
@@ -496,9 +500,15 @@ async def main_async(opts) -> None:
                 await kv_router.stop()
     finally:
         if tpu_engine is not None:
+            t0 = time.monotonic()
             tpu_engine.stop()
-        with contextlib.suppress(asyncio.TimeoutError):
+            logger.info("engine stopped in %.2fs", time.monotonic() - t0)
+        t0 = time.monotonic()
+        try:
             await asyncio.wait_for(drt.close(), 15)
+        except asyncio.TimeoutError:
+            logger.warning("runtime close timed out after 15s")
+        logger.info("runtime closed in %.2fs", time.monotonic() - t0)
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -518,6 +528,26 @@ def main(argv: list[str] | None = None) -> None:
     for sig in (signal.SIGINT, signal.SIGTERM):
         with contextlib.suppress(NotImplementedError, ValueError):
             loop.add_signal_handler(sig, _cancel)
+
+    def _dump_tasks(*_):
+        """SIGUSR1: print every pending task's stack — the first tool to
+        reach for when a node wedges during drain."""
+        import faulthandler
+
+        print("==== SIGUSR1 task dump ====", file=sys.stderr, flush=True)
+        for t in asyncio.all_tasks(loop):
+            print(f"-- {t.get_name()}: {t.get_coro()}", file=sys.stderr)
+            for f in t.get_stack(limit=6):
+                print(
+                    f"     {f.f_code.co_filename}:{f.f_lineno} "
+                    f"{f.f_code.co_name}",
+                    file=sys.stderr,
+                )
+        faulthandler.dump_traceback(file=sys.stderr)
+        sys.stderr.flush()
+
+    with contextlib.suppress(NotImplementedError, ValueError, AttributeError):
+        loop.add_signal_handler(signal.SIGUSR1, _dump_tasks)
     try:
         loop.run_until_complete(main_task)
     except asyncio.CancelledError:

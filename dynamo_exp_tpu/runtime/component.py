@@ -91,6 +91,19 @@ class DistributedRuntime:
         self.event_plane = event_plane or self.discovery.event_plane()
         self._namespaces: dict[str, Namespace] = {}
         self._primary_lease: Lease | None = None
+        self._bg_tasks: list = []
+
+    def spawn_background(self, coro, name: str):
+        """Run a long-lived coroutine tied to this runtime's lifetime
+        (heartbeats, re-publishers). Cancelled on close()."""
+        import asyncio
+
+        task = asyncio.get_running_loop().create_task(coro, name=name)
+        self._bg_tasks.append(task)
+        task.add_done_callback(
+            lambda t: self._bg_tasks.remove(t) if t in self._bg_tasks else None
+        )
+        return task
 
     @classmethod
     def from_settings(cls, config_path: str | None = None) -> "DistributedRuntime":
@@ -126,6 +139,21 @@ class DistributedRuntime:
         self.runtime.shutdown()
 
     async def close(self) -> None:
+        import asyncio
+
+        for task in list(self._bg_tasks):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                # Swallow only the bg task's own cancellation; if close()
+                # itself was cancelled (run.py bounds it with wait_for),
+                # that must propagate or the shutdown cap is defeated.
+                if not task.cancelled():
+                    raise
+            except Exception:  # noqa: BLE001 - a failing bg task must
+                pass  # not block runtime teardown
+        self._bg_tasks.clear()
         if self._primary_lease is not None and self._primary_lease.is_valid():
             await self._primary_lease.revoke()
         await self.request_plane.close()

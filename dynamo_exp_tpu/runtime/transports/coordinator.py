@@ -475,9 +475,23 @@ class CoordinatorClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         msg = TwoPartMessage(MsgType.DATA, {"op": op, "rid": rid, **(header or {})}, payload)
-        async with self._wlock:
-            await write_message(self._writer, msg)
+
+        # Shielded, with the lock INSIDE the shield: this connection is
+        # shared by every plane in the process. A caller cancelled
+        # mid-write would leave a partial frame on the socket and desync
+        # the stream for everyone — the write must complete atomically,
+        # and the lock must stay held until it does (a shield around the
+        # bare write would release the lock to the next writer while
+        # bytes are still going out).
+        async def _locked_write() -> None:
+            async with self._wlock:
+                await write_message(self._writer, msg)
+
+        await asyncio.shield(_locked_write())
+        t0 = time.monotonic()
         h, pl = await fut
+        if (dt := time.monotonic() - t0) > 1.0:
+            logger.warning("slow coordinator op %s: %.2fs", op, dt)
         if "error" in h:
             raise CoordinatorError(h["error"])
         return h, pl

@@ -7,6 +7,7 @@ per-worker leases, model dropped only when the last replica dies.
 """
 
 import asyncio
+import contextlib
 
 from dynamo_exp_tpu.http.discovery import ModelWatcher
 from dynamo_exp_tpu.http.service import ModelManager
@@ -187,3 +188,128 @@ async def test_rebind_on_identity_churn(tmp_path):
         )
     finally:
         await watcher.close()
+
+
+async def test_mdc_heartbeat_restamps_and_purges_on_close(tmp_path):
+    """Workers re-publish the card while alive (last_published advances,
+    revision increments) and the last replica's shutdown purges it from
+    the object store — the bucket never accumulates dead workers' cards."""
+    from dynamo_exp_tpu import local_model
+    from dynamo_exp_tpu.model_card import ModelDeploymentCard
+
+    model_dir = build_tiny_model_dir(str(tmp_path / "m"))
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    worker = DistributedRuntime(discovery=disc, request_plane=plane)
+    other = DistributedRuntime(discovery=disc, request_plane=plane)
+
+    # Shrink the heartbeat period so the test sees several beats.
+    orig = local_model._mdc_heartbeat
+
+    async def fast_beat(drt, mdc, lease, period_s=None):
+        await orig(drt, mdc, lease, period_s=0.05)
+
+    local_model._mdc_heartbeat = fast_beat
+    try:
+        ep1 = worker.namespace("t").component("w").endpoint("generate")
+        await register_llm(worker, ep1, model_dir, "tiny")
+        raw0 = await worker.object_store.get(local_model.MDC_BUCKET, "tiny")
+        card0 = ModelDeploymentCard.from_json(raw0.decode())
+        assert card0.last_published is not None and card0.revision >= 1
+
+        async def rev():
+            raw = await worker.object_store.get(local_model.MDC_BUCKET, "tiny")
+            return ModelDeploymentCard.from_json(raw.decode()).revision
+
+        await asyncio.sleep(0.2)
+        assert await rev() > card0.revision  # heartbeat re-stamped
+
+        # Second replica appears; first closes -> card must survive.
+        ep2 = other.namespace("t").component("w2").endpoint("generate")
+        await register_llm(other, ep2, model_dir, "tiny")
+        await worker.close()
+        assert (
+            await other.object_store.get(local_model.MDC_BUCKET, "tiny")
+        ) is not None
+
+        # Last replica closes -> card purged.
+        await other.close()
+        assert (
+            await other.object_store.get(local_model.MDC_BUCKET, "tiny")
+        ) is None
+    finally:
+        local_model._mdc_heartbeat = orig
+
+
+async def test_expired_card_never_builds_chain(tmp_path):
+    """Ingress must not serve from a card whose heartbeat went stale
+    (reference: model.rs is_expired / CARD_MAX_AGE)."""
+    from dynamo_exp_tpu import local_model
+    from dynamo_exp_tpu.model_card import ModelDeploymentCard
+
+    model_dir = build_tiny_model_dir(str(tmp_path / "m"))
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    worker = DistributedRuntime(discovery=disc, request_plane=plane)
+    ingress = DistributedRuntime(discovery=disc, request_plane=plane)
+
+    manager = ModelManager()
+    watcher = ModelWatcher(ingress, manager)
+    await watcher.start()
+    try:
+        ep = worker.namespace("t").component("w").endpoint("generate")
+        await register_llm(worker, ep, model_dir, "tiny")
+        # Overwrite the published card with a long-expired stamp, as if
+        # every heartbeat stopped 10 minutes ago.
+        raw = await worker.object_store.get(local_model.MDC_BUCKET, "tiny")
+        card = ModelDeploymentCard.from_json(raw.decode())
+        card.last_published = card.last_published - 600.0
+        await worker.object_store.put(
+            local_model.MDC_BUCKET, "tiny", card.to_json().encode()
+        )
+        # Building a serving chain from the expired card must fail.
+        import pytest
+
+        from dynamo_exp_tpu.local_model import ModelEntry
+
+        entry_raw = list((await disc.kv_get_prefix("models/tiny/")).values())[0]
+        entry = ModelEntry.from_bytes(entry_raw)
+        with pytest.raises(RuntimeError, match="expired"):
+            await watcher._build_chain(entry)
+    finally:
+        await watcher.close()
+
+
+async def test_expired_card_sweep_deletes_stale_only(tmp_path):
+    """The ingress sweep removes cards with stale heartbeats and leaves
+    fresh ones (reference: model.rs expiry watcher)."""
+    import time as _time
+
+    from dynamo_exp_tpu.local_model import MDC_BUCKET
+    from dynamo_exp_tpu.model_card import ModelDeploymentCard
+
+    disc = InProcDiscovery()
+    plane = InProcRequestPlane()
+    ingress = DistributedRuntime(discovery=disc, request_plane=plane)
+    store = ingress.object_store
+
+    fresh = ModelDeploymentCard(display_name="fresh")
+    fresh.stamp()
+    stale = ModelDeploymentCard(display_name="stale")
+    stale.last_published = _time.time() - 3600.0
+    await store.put(MDC_BUCKET, fresh.slug, fresh.to_json().encode())
+    await store.put(MDC_BUCKET, stale.slug, stale.to_json().encode())
+
+    watcher = ModelWatcher(ingress, ModelManager())
+    sweep = asyncio.ensure_future(watcher._sweep_expired_cards(period_s=0.05))
+    try:
+        assert await _wait_for(
+            lambda: True, timeout=0.2
+        )  # let a couple of sweep periods elapse
+        await asyncio.sleep(0.2)
+        assert await store.get(MDC_BUCKET, "stale") is None
+        assert await store.get(MDC_BUCKET, "fresh") is not None
+    finally:
+        sweep.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await sweep
