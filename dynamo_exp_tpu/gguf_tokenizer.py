@@ -1,0 +1,157 @@
+"""Tokenizer reconstruction from GGUF-embedded metadata.
+
+Capability parity with the reference's GGUF tokenizer conversion
+(``/root/reference/lib/llm/src/gguf/gguf_tokenizer.rs:1-260``, itself
+following transformers' convert_slow_tokenizer recipe): a bare ``.gguf``
+carries its full tokenizer under ``tokenizer.ggml.*`` — token strings,
+unigram scores or BPE merges, token types, special-token ids — and must
+serve end-to-end WITHOUT a side tokenizer.json.
+
+Two embedded models are supported, same as the reference:
+
+- ``llama``  → SentencePiece-style **Unigram**: vocab = (token, score)
+  pairs, byte fallback, the ``▁``-prefix normalizer and the matching
+  decoder chain.
+- ``gpt2``   → byte-level **BPE**: vocab + space-separated merge pairs,
+  ByteLevel pre-tokenizer/decoder.
+
+Everything is built with the HF ``tokenizers`` Python API — the same
+library the rest of the stack already uses — so DecodeStream and the
+preprocessor work identically whether the tokenizer came from
+tokenizer.json, tokenizer.model (see ``sp_model.py``), or a GGUF.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+# tokenizer.ggml.token_type values (llama.cpp enum).
+TOKEN_NORMAL = 1
+TOKEN_UNKNOWN = 2
+TOKEN_CONTROL = 3
+TOKEN_USER_DEFINED = 4
+TOKEN_UNUSED = 5
+TOKEN_BYTE = 6
+
+
+def _build_unigram(tokens, scores, unk_id: int | None):
+    """SentencePiece-as-Unigram with the canonical normalizer/decoder
+    chain (reference: gguf_tokenizer.rs unigram_tokenizer)."""
+    from tokenizers import Tokenizer, decoders, models, normalizers
+
+    if scores is None:
+        raise ValueError(
+            "llama-model GGUF tokenizer is missing tokenizer.ggml.scores"
+        )
+    vocab = [(t, float(s)) for t, s in zip(tokens, scores)]
+    tok = Tokenizer(
+        models.Unigram(vocab, unk_id=unk_id if unk_id is not None else 0,
+                       byte_fallback=True)
+    )
+    tok.normalizer = normalizers.Sequence(
+        [normalizers.Prepend("▁"), normalizers.Replace(" ", "▁")]
+    )
+    tok.decoder = decoders.Sequence(
+        [
+            decoders.Replace("▁", " "),
+            decoders.ByteFallback(),
+            decoders.Fuse(),
+            decoders.Strip(" ", 1, 0),
+        ]
+    )
+    return tok
+
+
+def _build_bpe(tokens, merges):
+    """Byte-level BPE (reference: gguf_tokenizer.rs bpe_tokenizer)."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+
+    if merges is None:
+        raise ValueError(
+            "gpt2-model GGUF tokenizer is missing tokenizer.ggml.merges"
+        )
+    vocab = {t: i for i, t in enumerate(tokens)}
+    merge_pairs = []
+    for m in merges:
+        a, _, b = m.partition(" ")
+        merge_pairs.append((a, b))
+    tok = Tokenizer(models.BPE(vocab=vocab, merges=merge_pairs))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    return tok
+
+
+def tokenizer_backend_from_gguf(gguf):
+    """Build a ``tokenizers.Tokenizer`` from a parsed ``GGUFFile`` (or
+    any object with a ``metadata`` dict)."""
+    md = gguf.metadata
+    model = md.get("tokenizer.ggml.model")
+    tokens = md.get("tokenizer.ggml.tokens")
+    if model is None or tokens is None:
+        raise ValueError(
+            "GGUF has no embedded tokenizer "
+            "(tokenizer.ggml.model/tokens missing)"
+        )
+    token_type = md.get("tokenizer.ggml.token_type")
+    unk_id = md.get("tokenizer.ggml.unknown_token_id")
+    if unk_id is None and token_type is not None:
+        unk = [i for i, t in enumerate(token_type) if t == TOKEN_UNKNOWN]
+        unk_id = unk[0] if unk else None
+
+    if model in ("llama", "replit"):
+        tok = _build_unigram(tokens, md.get("tokenizer.ggml.scores"), unk_id)
+    elif model == "gpt2":
+        tok = _build_bpe(tokens, md.get("tokenizer.ggml.merges"))
+    else:
+        raise ValueError(f"unsupported GGUF tokenizer model {model!r}")
+
+    # Special tokens: bos/eos/unk plus every CONTROL-typed token, marked
+    # special so skip_special_tokens decoding drops them.
+    from tokenizers import AddedToken
+
+    special_ids = {
+        md.get("tokenizer.ggml.bos_token_id"),
+        md.get("tokenizer.ggml.eos_token_id"),
+        unk_id,
+    } - {None}
+    if token_type is not None:
+        special_ids.update(
+            i for i, t in enumerate(token_type) if t == TOKEN_CONTROL
+        )
+    specials = [
+        AddedToken(tokens[i], special=True)
+        for i in sorted(special_ids)
+        if i < len(tokens)
+    ]
+    if specials:
+        tok.add_special_tokens(specials)
+
+    # add_bos_token: prepend BOS via a template post-processor, the same
+    # behavior HF llama tokenizers encode in tokenizer.json. When the
+    # key is absent, llama.cpp defaults SPM (unigram) vocabularies to
+    # add_bos=true and BPE to false — older GGUF exports rely on that.
+    bos_id = md.get("tokenizer.ggml.bos_token_id")
+    default_add_bos = model in ("llama", "replit")
+    if md.get("tokenizer.ggml.add_bos_token", default_add_bos) and bos_id is not None:
+        from tokenizers import processors
+
+        bos = tokens[bos_id]
+        tok.post_processor = processors.TemplateProcessing(
+            single=f"{bos} $A",
+            pair=f"{bos} $A {bos} $B",
+            special_tokens=[(bos, bos_id)],
+        )
+    return tok
+
+
+def tokenizer_from_gguf(path: str):
+    """Load a serving ``Tokenizer`` facade straight from a ``.gguf``."""
+    from .models.gguf import GGUFFile
+    from .tokenizer import Tokenizer
+
+    gguf = GGUFFile.parse(path)
+    backend = tokenizer_backend_from_gguf(gguf)
+    eos = gguf.metadata.get("tokenizer.ggml.eos_token_id")
+    return Tokenizer(backend, [int(eos)] if eos is not None else [])

@@ -84,10 +84,48 @@ class ModelDeploymentCard:
         return cls(**{k: v for k, v in d.items() if k in known})
 
     @classmethod
+    def from_gguf(
+        cls, path: str, display_name: str | None = None, gguf=None
+    ) -> "ModelDeploymentCard":
+        """Build a card from a bare ``.gguf`` — the file itself carries
+        the tokenizer (``tokenizer.ggml.*``) and often a chat template
+        (``tokenizer.chat_template``), so no side files are needed
+        (reference: GGUF as a self-contained model artifact,
+        model.rs PromptFormatterArtifact::GGUF). Pass an already-parsed
+        ``GGUFFile`` via ``gguf`` to avoid re-reading a large vocab."""
+        if gguf is None:
+            from .models.gguf import GGUFFile
+
+            gguf = GGUFFile.parse(path)
+        md = gguf.metadata
+        name = display_name or md.get("general.name") or os.path.basename(path)
+        card = cls(display_name=name, model_path=path, tokenizer_path=path)
+        arch = md.get("general.architecture", "llama")
+        ctx = md.get(f"{arch}.context_length")
+        if ctx:
+            card.context_length = int(ctx)
+        eos = md.get("tokenizer.ggml.eos_token_id")
+        if eos is not None:
+            card.eos_token_ids = [int(eos)]
+        tpl = md.get("tokenizer.chat_template")
+        if isinstance(tpl, str) and tpl:
+            card.chat_template = tpl
+        tokens = md.get("tokenizer.ggml.tokens")
+        bos = md.get("tokenizer.ggml.bos_token_id")
+        if tokens:
+            if bos is not None and bos < len(tokens):
+                card.bos_token = tokens[bos]
+            if eos is not None and eos < len(tokens):
+                card.eos_token = tokens[eos]
+        return card
+
+    @classmethod
     def from_local_path(
         cls, path: str, display_name: str | None = None
     ) -> "ModelDeploymentCard":
-        """Build a card from a HF-style model directory."""
+        """Build a card from a HF-style model directory (or a .gguf)."""
+        if path.endswith(".gguf"):
+            return cls.from_gguf(path, display_name)
         name = display_name or os.path.basename(os.path.normpath(path))
         card = cls(display_name=name, model_path=path, tokenizer_path=path)
         cfg_path = os.path.join(path, "config.json")

@@ -236,14 +236,15 @@ def _unpermute_rope(w: np.ndarray, n_heads: int) -> np.ndarray:
     )
 
 
-def load_params_from_gguf(path: str, cfg=None):
+def load_params_from_gguf(path: str, cfg=None, gguf=None):
     """GGUF → (stacked param pytree, ModelConfig) matching
-    ``models/loader.load_params``'s output."""
+    ``models/loader.load_params``'s output. Pass an already-parsed
+    ``GGUFFile`` via ``gguf`` to avoid re-reading the metadata."""
     import jax.numpy as jnp
 
     from .llama import _dtype
 
-    g = GGUFFile.parse(path)
+    g = gguf if gguf is not None else GGUFFile.parse(path)
     if cfg is None:
         cfg = config_from_gguf(g)
     dt = _dtype(cfg)
@@ -347,6 +348,11 @@ def write_gguf(
             if v and isinstance(v[0], str):
                 body = b"".join(pstr(x) for x in v)
                 etype = T_STRING
+            elif v and any(isinstance(x, float) for x in v):
+                # Any float ⇒ float array: checking only v[0] would let
+                # scores like [0, -1.5, …] silently truncate to I64.
+                body = b"".join(struct.pack("<f", float(x)) for x in v)
+                etype = T_F32
             else:
                 body = b"".join(struct.pack("<q", int(x)) for x in v)
                 etype = T_I64

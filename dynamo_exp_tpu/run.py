@@ -116,14 +116,32 @@ def build_tpu_engine(opts):
 
         opts.model_path = resolve_model_path(opts.model_path)
         if opts.model_path.endswith(".gguf"):
-            from .models.gguf import config_from_gguf, load_params_from_gguf
+            from .models.gguf import (
+                GGUFFile,
+                config_from_gguf,
+                load_params_from_gguf,
+            )
 
+            # Parse the metadata section once; a real vocab is ~100k+
+            # strings and re-parsing per consumer wastes startup time.
+            gguf = GGUFFile.parse(opts.model_path)
             if opts.random_weights:
-                from .models.gguf import GGUFFile
-
-                mcfg = config_from_gguf(GGUFFile.parse(opts.model_path))
+                mcfg = config_from_gguf(gguf)
             else:
-                params, mcfg = load_params_from_gguf(opts.model_path)
+                params, mcfg = load_params_from_gguf(opts.model_path, gguf=gguf)
+            # Self-contained GGUF: tokenizer + chat template come from
+            # the file's own metadata, so the OpenAI surface serves with
+            # no side tokenizer.json (gguf_tokenizer.rs parity). A GGUF
+            # without an embedded tokenizer serves token-level only.
+            if "tokenizer.ggml.tokens" in gguf.metadata:
+                mdc = ModelDeploymentCard.from_gguf(
+                    opts.model_path, opts.model_name or None, gguf=gguf
+                )
+                mdc.kv_cache_block_size = opts.page_size
+            else:
+                logger.warning(
+                    "GGUF has no embedded tokenizer; serving token-level only"
+                )
         else:
             mcfg = ModelConfig.from_pretrained(opts.model_path)
             mdc = ModelDeploymentCard.from_local_path(
@@ -208,10 +226,20 @@ def require_mdc(opts):
         raise SystemExit(f"in={opts.input} with out={opts.output} needs --model-path")
     opts.model_path = resolve_model_path(opts.model_path)
     if opts.model_path.endswith(".gguf"):
-        raise SystemExit(
-            "this node shape needs a tokenizer/chat template; GGUF files "
-            "carry weights only here — pass an HF-style --model-path dir"
+        from .models.gguf import GGUFFile
+
+        g = GGUFFile.parse(opts.model_path)
+        if "tokenizer.ggml.tokens" not in g.metadata:
+            raise SystemExit(
+                "this node shape needs a tokenizer/chat template and this "
+                "GGUF has no embedded tokenizer (tokenizer.ggml.*) — pass "
+                "an HF-style --model-path dir or a self-contained GGUF"
+            )
+        mdc = ModelDeploymentCard.from_gguf(
+            opts.model_path, opts.model_name or None, gguf=g
         )
+        mdc.kv_cache_block_size = opts.page_size
+        return mdc
     mdc = ModelDeploymentCard.from_local_path(opts.model_path, opts.model_name or None)
     mdc.kv_cache_block_size = opts.page_size
     return mdc

@@ -1,0 +1,143 @@
+"""SentencePiece ``tokenizer.model`` backend without the sentencepiece lib.
+
+Capability parity with the reference's SentencePiece tokenizer backend
+(``/root/reference/lib/llm/src/tokenizers/sp.rs:1-109``): load a model
+directory that ships only ``tokenizer.model`` (no tokenizer.json) and
+serve it. The reference links the sentencepiece C++ library; here the
+``.model`` file — a protobuf ``ModelProto`` — is parsed directly with a
+minimal wire-format reader (varint + length-delimited fields are all we
+need), and the pieces feed the exact Unigram construction that
+``gguf_tokenizer.py`` uses, since SentencePiece *is* the unigram model.
+
+ModelProto layout (sentencepiece.proto):
+  field 1: repeated SentencePiece { 1: piece (string),
+                                    2: score (float),
+                                    3: type  (enum) }
+  field 2: TrainerSpec  { 40: unk_id, 41: bos_id, 42: eos_id, ... }
+"""
+
+from __future__ import annotations
+
+import struct
+
+# SentencePiece piece types.
+SP_NORMAL = 1
+SP_UNKNOWN = 2
+SP_CONTROL = 3
+SP_USER_DEFINED = 4
+SP_UNUSED = 5
+SP_BYTE = 6
+
+_WIRE_VARINT = 0
+_WIRE_I64 = 1
+_WIRE_LEN = 2
+_WIRE_I32 = 5
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Iterate (field_number, wire_type, value) over one message."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == _WIRE_VARINT:
+            val, pos = _read_varint(buf, pos)
+        elif wire == _WIRE_I64:
+            val = buf[pos : pos + 8]
+            pos += 8
+        elif wire == _WIRE_LEN:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos : pos + ln]
+            pos += ln
+        elif wire == _WIRE_I32:
+            val = buf[pos : pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        yield field, wire, val
+
+
+def parse_sentencepiece_model(path: str):
+    """Return (pieces, special_ids) from a ``tokenizer.model`` file.
+
+    ``pieces`` is ``[(piece, score, type), ...]`` in id order;
+    ``special_ids`` maps {"unk"|"bos"|"eos"|"pad": id} for ids the
+    TrainerSpec pins (-1 entries are omitted).
+    """
+    with open(path, "rb") as f:
+        buf = f.read()
+    pieces: list[tuple[str, float, int]] = []
+    special_ids: dict[str, int] = {}
+    for field, wire, val in _fields(buf):
+        if field == 1 and wire == _WIRE_LEN:  # repeated SentencePiece
+            piece, score, ptype = "", 0.0, SP_NORMAL
+            for f2, w2, v2 in _fields(val):
+                if f2 == 1 and w2 == _WIRE_LEN:
+                    piece = v2.decode("utf-8")
+                elif f2 == 2 and w2 == _WIRE_I32:
+                    score = struct.unpack("<f", v2)[0]
+                elif f2 == 3 and w2 == _WIRE_VARINT:
+                    ptype = v2
+            pieces.append((piece, score, ptype))
+        elif field == 2 and wire == _WIRE_LEN:  # TrainerSpec
+            ids = {40: "unk", 41: "bos", 42: "eos", 43: "pad"}
+            for f2, w2, v2 in _fields(val):
+                if f2 in ids and w2 == _WIRE_VARINT:
+                    # negative ids are varint-encoded as 2^64-|x|; treat
+                    # anything that large as "disabled".
+                    if v2 < 1 << 31:
+                        special_ids[ids[f2]] = v2
+    if not pieces:
+        raise ValueError(f"{path} contains no sentencepiece pieces")
+    return pieces, special_ids
+
+
+def tokenizer_backend_from_sp(path: str, add_bos: bool = True):
+    """Build a ``tokenizers.Tokenizer`` (Unigram) from a ``.model`` file."""
+    from tokenizers import AddedToken
+
+    from .gguf_tokenizer import _build_unigram
+
+    pieces, special_ids = parse_sentencepiece_model(path)
+    tokens = [p for p, _, _ in pieces]
+    scores = [s for _, s, _ in pieces]
+    unk_id = special_ids.get("unk")
+    if unk_id is None:
+        unk = [i for i, (_, _, t) in enumerate(pieces) if t == SP_UNKNOWN]
+        unk_id = unk[0] if unk else 0
+    tok = _build_unigram(tokens, scores, unk_id)
+
+    control = [
+        AddedToken(p, special=True)
+        for p, _, t in pieces
+        if t in (SP_CONTROL, SP_UNKNOWN)
+    ]
+    if control:
+        tok.add_special_tokens(control)
+
+    bos_id = special_ids.get("bos")
+    if add_bos and bos_id is not None:
+        from tokenizers import processors
+
+        bos = tokens[bos_id]
+        tok.post_processor = processors.TemplateProcessing(
+            single=f"{bos} $A",
+            pair=f"{bos} $A {bos} $B",
+            special_tokens=[(bos, bos_id)],
+        )
+    return tok
+
+

@@ -34,14 +34,41 @@ class Tokenizer:
     # --- construction -------------------------------------------------
     @classmethod
     def from_pretrained(cls, path: str) -> "Tokenizer":
-        """Load from a model directory / file / HF hub id."""
+        """Load from a model directory / file / HF hub id.
+
+        Resolution order inside a directory mirrors the reference's
+        tokenizer kinds (tokenizers.rs + tokenizers/sp.rs): fast
+        tokenizer.json first, then a bare SentencePiece tokenizer.model
+        (``sp_model.py``), then the transformers fallback. A ``.gguf``
+        path reconstructs the embedded tokenizer (gguf_tokenizer.rs
+        parity)."""
         eos_ids: list[int] = []
+        if path.endswith(".gguf") and os.path.exists(path):
+            from .gguf_tokenizer import tokenizer_from_gguf
+
+            return tokenizer_from_gguf(path)
         if os.path.isdir(path):
             tok_json = os.path.join(path, "tokenizer.json")
             if os.path.exists(tok_json):
                 import tokenizers
 
                 backend = tokenizers.Tokenizer.from_file(tok_json)
+                eos_ids = _eos_ids_from_config(path, backend)
+                return cls(backend, eos_ids)
+            sp_path = os.path.join(path, "tokenizer.model")
+            if os.path.exists(sp_path):
+                import json
+
+                from .sp_model import tokenizer_backend_from_sp
+
+                # Honor tokenizer_config.json's add_bos_token when the
+                # directory ships one (HF llama default is true).
+                add_bos = True
+                tcfg_path = os.path.join(path, "tokenizer_config.json")
+                if os.path.exists(tcfg_path):
+                    with open(tcfg_path) as f:
+                        add_bos = bool(json.load(f).get("add_bos_token", True))
+                backend = tokenizer_backend_from_sp(sp_path, add_bos=add_bos)
                 eos_ids = _eos_ids_from_config(path, backend)
                 return cls(backend, eos_ids)
         elif path.endswith(".json") and os.path.exists(path):
