@@ -133,6 +133,10 @@ def tokenizer_backend_from_gguf(gguf):
     # key is absent, llama.cpp defaults SPM (unigram) vocabularies to
     # add_bos=true and BPE to false — older GGUF exports rely on that.
     bos_id = md.get("tokenizer.ggml.bos_token_id")
+    if bos_id is not None and bos_id >= len(tokens):
+        raise ValueError(
+            f"GGUF bos_token_id {bos_id} out of range for vocab of {len(tokens)}"
+        )
     default_add_bos = model in ("llama", "replit")
     if md.get("tokenizer.ggml.add_bos_token", default_add_bos) and bos_id is not None:
         from tokenizers import processors

@@ -348,9 +348,10 @@ def write_gguf(
             if v and isinstance(v[0], str):
                 body = b"".join(pstr(x) for x in v)
                 etype = T_STRING
-            elif v and any(isinstance(x, float) for x in v):
-                # Any float ⇒ float array: checking only v[0] would let
-                # scores like [0, -1.5, …] silently truncate to I64.
+            elif v and any(isinstance(x, (float, np.floating)) for x in v):
+                # Any float (Python or numpy) ⇒ float array: checking
+                # only v[0] — or only builtin float — would let scores
+                # like [0, -1.5, …] silently truncate to I64.
                 body = b"".join(struct.pack("<f", float(x)) for x in v)
                 etype = T_F32
             else:

@@ -1,0 +1,296 @@
+"""CLIP-style vision tower in JAX: the multimodal encode path.
+
+Capability parity with the reference's multimodal encode worker
+(``/root/reference/examples/multimodal/components/encode_worker.py:21-60``:
+an HF vision tower + multi-modal projector running on its own device,
+streaming image features to the LLM worker). TPU-native design: the ViT
+is a stacked-layer ``lax.scan`` transformer like ``models/llama.py`` —
+patch conv → [CLS] + position embeddings → pre-LN encoder blocks — and
+real HF ``CLIPVisionModel`` safetensors load directly (same tensor
+names transformers writes), so a tiny random-but-real checkpoint
+round-trips bit-for-bit through this forward.
+
+The output seam matches LLaVA: ``last_hidden_state`` (no post-LN, as HF
+returns it), patch features selected by dropping [CLS], then the
+two-layer ``multi_modal_projector`` maps them to the LM hidden size for
+consumption as soft tokens via ``llama.forward(token_embeds=...)``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    image_size: int = 224
+    patch_size: int = 32
+    num_channels: int = 3
+    layer_norm_eps: float = 1e-5
+    hidden_act: str = "quick_gelu"
+    # Projector to the LM hidden size (LLaVA multi_modal_projector);
+    # None = tower only.
+    projector_dim: int | None = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @classmethod
+    def from_hf_config(cls, cfg: dict) -> "VisionConfig":
+        """Accepts a CLIPVisionConfig dict, or a full multimodal
+        config.json carrying ``vision_config`` (LLaVA-style)."""
+        projector_dim = None
+        if "vision_config" in cfg:
+            projector_dim = (
+                cfg.get("text_config", {}).get("hidden_size")
+                or cfg.get("hidden_size")
+            )
+            cfg = cfg["vision_config"]
+        return cls(
+            hidden_size=cfg.get("hidden_size", 768),
+            intermediate_size=cfg.get("intermediate_size", 3072),
+            num_layers=cfg.get("num_hidden_layers", 12),
+            num_heads=cfg.get("num_attention_heads", 12),
+            image_size=cfg.get("image_size", 224),
+            patch_size=cfg.get("patch_size", 32),
+            num_channels=cfg.get("num_channels", 3),
+            layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+            hidden_act=cfg.get("hidden_act", "quick_gelu"),
+            projector_dim=projector_dim,
+        )
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "VisionConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f))
+
+
+def _act(name: str):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    if name in ("gelu_pytorch_tanh", "gelu_new"):
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    return lambda x: jax.nn.gelu(x, approximate=False)
+
+
+def init_projector_params(key, cfg: VisionConfig, dtype=jnp.float32) -> dict:
+    """Just the multi_modal_projector tensors (for attaching a fresh
+    projector to a tower-only checkpoint without re-initializing — and
+    discarding — a full random tower)."""
+    if not cfg.projector_dim:
+        raise ValueError("projector_dim unset")
+    h, d = cfg.hidden_size, cfg.projector_dim
+    k1, k2 = jax.random.split(key)
+    return {
+        "proj1": (jax.random.normal(k1, (h, d)) * h**-0.5).astype(dtype),
+        "proj1_b": jnp.zeros(d, dtype),
+        "proj2": (jax.random.normal(k2, (d, d)) * d**-0.5).astype(dtype),
+        "proj2_b": jnp.zeros(d, dtype),
+    }
+
+
+def init_vision_params(key, cfg: VisionConfig, dtype=jnp.float32) -> dict:
+    """Random tower (+ projector when projector_dim is set), stacked
+    [num_layers, ...] like the LM params."""
+    h, ffn, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def init(k, *shape, scale=None):
+        scale = scale if scale is not None else shape[0] ** -0.5
+        return (jax.random.normal(k, shape) * scale).astype(dtype)
+
+    p = {
+        "patch_embed": init(
+            next(keys), cfg.patch_size * cfg.patch_size * cfg.num_channels, h
+        ),
+        "cls_embed": init(next(keys), h, scale=0.02),
+        "pos_embed": init(next(keys), cfg.num_patches + 1, h, scale=0.02),
+        "pre_ln": jnp.ones(h, dtype),
+        "pre_ln_b": jnp.zeros(h, dtype),
+        "post_ln": jnp.ones(h, dtype),
+        "post_ln_b": jnp.zeros(h, dtype),
+        "ln1": jnp.ones((L, h), dtype),
+        "ln1_b": jnp.zeros((L, h), dtype),
+        "ln2": jnp.ones((L, h), dtype),
+        "ln2_b": jnp.zeros((L, h), dtype),
+        "wq": init(next(keys), L, h, h, scale=h**-0.5),
+        "wq_b": jnp.zeros((L, h), dtype),
+        "wk": init(next(keys), L, h, h, scale=h**-0.5),
+        "wk_b": jnp.zeros((L, h), dtype),
+        "wv": init(next(keys), L, h, h, scale=h**-0.5),
+        "wv_b": jnp.zeros((L, h), dtype),
+        "wo": init(next(keys), L, h, h, scale=h**-0.5),
+        "wo_b": jnp.zeros((L, h), dtype),
+        "w1": init(next(keys), L, h, ffn, scale=h**-0.5),
+        "w1_b": jnp.zeros((L, ffn), dtype),
+        "w2": init(next(keys), L, ffn, h, scale=ffn**-0.5),
+        "w2_b": jnp.zeros((L, h), dtype),
+    }
+    if cfg.projector_dim:
+        p.update(init_projector_params(next(keys), cfg, dtype))
+    return p
+
+
+def _ln(x, w, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def vision_forward(params: dict, cfg: VisionConfig, pixels) -> jnp.ndarray:
+    """[B, H, W, C] float pixels → last_hidden_state [B, 1+P, hidden]
+    (HF CLIPVisionModel semantics: no post-LN on the sequence)."""
+    B = pixels.shape[0]
+    p, h = cfg.patch_size, cfg.hidden_size
+    grid = cfg.image_size // p
+    act = _act(cfg.hidden_act)
+
+    # Patchify + project (the conv with stride=kernel=patch IS a matmul
+    # over flattened patches — MXU-friendly, no conv needed).
+    x = (
+        pixels[:, : grid * p, : grid * p, :]
+        .reshape(B, grid, p, grid, p, cfg.num_channels)
+        .transpose(0, 1, 3, 2, 4, 5)
+        .reshape(B, grid * grid, p * p * cfg.num_channels)
+    )
+    x = x @ params["patch_embed"]
+    cls = jnp.broadcast_to(params["cls_embed"], (B, 1, h))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos_embed"][None]
+    x = _ln(x, params["pre_ln"], params["pre_ln_b"], cfg.layer_norm_eps)
+
+    nh, hd = cfg.num_heads, cfg.head_dim
+    T = x.shape[1]
+
+    def layer(x, lp):
+        y = _ln(x, lp["ln1"], lp["ln1_b"], cfg.layer_norm_eps)
+        q = (y @ lp["wq"] + lp["wq_b"]).reshape(B, T, nh, hd)
+        k = (y @ lp["wk"] + lp["wk_b"]).reshape(B, T, nh, hd)
+        v = (y @ lp["wv"] + lp["wv_b"]).reshape(B, T, nh, hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * hd**-0.5
+        attn = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, T, h)
+        x = x + o @ lp["wo"] + lp["wo_b"]
+        y = _ln(x, lp["ln2"], lp["ln2_b"], cfg.layer_norm_eps)
+        x = x + act(y @ lp["w1"] + lp["w1_b"]) @ lp["w2"] + lp["w2_b"]
+        return x, None
+
+    layer_params = {
+        k: params[k]
+        for k in (
+            "ln1", "ln1_b", "ln2", "ln2_b", "wq", "wq_b", "wk", "wk_b",
+            "wv", "wv_b", "wo", "wo_b", "w1", "w1_b", "w2", "w2_b",
+        )
+    }
+    x, _ = jax.lax.scan(layer, x, layer_params)
+    return x
+
+
+def select_patch_features(hidden: jnp.ndarray) -> jnp.ndarray:
+    """LLaVA default feature selection: drop [CLS]."""
+    return hidden[:, 1:, :]
+
+
+def project_features(params: dict, cfg: VisionConfig, feats) -> jnp.ndarray:
+    """multi_modal_projector: linear → gelu → linear into LM hidden."""
+    if "proj1" not in params:
+        raise ValueError("vision params carry no projector (projector_dim unset)")
+    x = feats @ params["proj1"] + params["proj1_b"]
+    x = jax.nn.gelu(x, approximate=False)
+    return x @ params["proj2"] + params["proj2_b"]
+
+
+def encode_image(params: dict, cfg: VisionConfig, pixels) -> jnp.ndarray:
+    """pixels [B,H,W,C] → soft tokens [B, P, lm_hidden] (tower + select
+    + projector): the full encode-worker hot path, one jit."""
+    hidden = vision_forward(params, cfg, pixels)
+    return project_features(params, cfg, select_patch_features(hidden))
+
+
+# ------------------------------------------------------------- HF loading
+def load_vision_params(path: str, cfg: VisionConfig | None = None):
+    """Load a HF ``CLIPVisionModel`` (or LLaVA ``vision_tower.*``)
+    safetensors checkpoint into the stacked layout. Returns (params, cfg).
+
+    Reference seam: encode_worker.py loads the HF tower with
+    transformers; here the same tensors feed the JAX forward."""
+    from .loader import _open_safetensors
+
+    if cfg is None:
+        cfg = VisionConfig.from_pretrained(path)
+    handles, index = _open_safetensors(path)
+
+    def get(name: str) -> np.ndarray:
+        for prefix in ("", "vision_tower."):
+            full = prefix + name
+            if full in index:
+                return np.asarray(handles[index[full]].get_tensor(full))
+        raise KeyError(name)
+
+    vp = "vision_model."
+    L, h = cfg.num_layers, cfg.hidden_size
+    # Conv patch embedding [h, C, p, p] → flattened-patch matmul
+    # [(p*p*C), h]: transpose kernel to (p, p, C) order to match the
+    # patchify layout in vision_forward.
+    conv = get(vp + "embeddings.patch_embedding.weight")
+    patch_w = conv.transpose(2, 3, 1, 0).reshape(-1, h)
+
+    def stack(fmt: str, transpose: bool = False) -> np.ndarray:
+        mats = [get(vp + fmt.format(i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return np.stack(mats)
+
+    params = {
+        "patch_embed": patch_w,
+        "cls_embed": get(vp + "embeddings.class_embedding"),
+        "pos_embed": get(vp + "embeddings.position_embedding.weight"),
+        "pre_ln": get(vp + "pre_layrnorm.weight"),
+        "pre_ln_b": get(vp + "pre_layrnorm.bias"),
+        "post_ln": get(vp + "post_layernorm.weight"),
+        "post_ln_b": get(vp + "post_layernorm.bias"),
+        "ln1": stack("encoder.layers.{}.layer_norm1.weight"),
+        "ln1_b": stack("encoder.layers.{}.layer_norm1.bias"),
+        "ln2": stack("encoder.layers.{}.layer_norm2.weight"),
+        "ln2_b": stack("encoder.layers.{}.layer_norm2.bias"),
+        "wq": stack("encoder.layers.{}.self_attn.q_proj.weight", True),
+        "wq_b": stack("encoder.layers.{}.self_attn.q_proj.bias"),
+        "wk": stack("encoder.layers.{}.self_attn.k_proj.weight", True),
+        "wk_b": stack("encoder.layers.{}.self_attn.k_proj.bias"),
+        "wv": stack("encoder.layers.{}.self_attn.v_proj.weight", True),
+        "wv_b": stack("encoder.layers.{}.self_attn.v_proj.bias"),
+        "wo": stack("encoder.layers.{}.self_attn.out_proj.weight", True),
+        "wo_b": stack("encoder.layers.{}.self_attn.out_proj.bias"),
+        "w1": stack("encoder.layers.{}.mlp.fc1.weight", True),
+        "w1_b": stack("encoder.layers.{}.mlp.fc1.bias"),
+        "w2": stack("encoder.layers.{}.mlp.fc2.weight", True),
+        "w2_b": stack("encoder.layers.{}.mlp.fc2.bias"),
+    }
+    # LLaVA projector when present.
+    for src, dst in (
+        ("multi_modal_projector.linear_1.weight", "proj1"),
+        ("multi_modal_projector.linear_1.bias", "proj1_b"),
+        ("multi_modal_projector.linear_2.weight", "proj2"),
+        ("multi_modal_projector.linear_2.bias", "proj2_b"),
+    ):
+        try:
+            t = get(src)
+            params[dst] = t.T if dst in ("proj1", "proj2") else t
+        except KeyError:
+            pass
+    params = {k: jnp.asarray(v) for k, v in params.items()}
+    return params, cfg

@@ -301,7 +301,7 @@ async def run_http(opts, drt, core, full, mdc):
         await svc.stop()
 
 
-async def run_worker(opts, drt, core, tpu_engine):
+async def run_worker(opts, drt, core, tpu_engine, mdc=None):
     """Worker node: serve the core engine on a discoverable endpoint
     (reference: EngineConfig::StaticCore + Ingress, lib.rs:200-300)."""
     from .kv_router.publisher import KvEventPublisher, KvMetricsPublisher
@@ -338,10 +338,19 @@ async def run_worker(opts, drt, core, tpu_engine):
                 metrics_pub.update(ForwardPassMetrics.from_dict(tpu_engine.metrics()))
 
         drt.runtime.spawn(pump_metrics())
-    if opts.model_path:
+    if opts.model_path and mdc is not None:
         await register_llm(
             drt, ep, opts.model_path, opts.model_name or None,
             kv_cache_block_size=opts.page_size,
+        )
+    elif opts.model_path:
+        # A tokenizer-less artifact (weights-only GGUF) must not be
+        # advertised to OpenAI ingress: the frontend would loop forever
+        # failing to build a preprocessor chain from its card.
+        logger.warning(
+            "not registering %s with ingress: no tokenizer available "
+            "(token-level clients can still target this endpoint directly)",
+            opts.model_path,
         )
     print(f"worker serving {opts.input} (instance {served.instance_id})", flush=True)
     try:
@@ -508,7 +517,7 @@ async def main_async(opts) -> None:
         if opts.input.startswith("dyn://"):
             if core is None:
                 raise SystemExit("in=dyn:// needs a local engine (out=tpu|echo_core)")
-            await run_worker(opts, drt, core, tpu_engine)
+            await run_worker(opts, drt, core, tpu_engine, mdc)
             return
         # Local text-ish drivers need an OpenAI-level engine.
         engine, mdc, kv_router = await resolve_openai_engine(

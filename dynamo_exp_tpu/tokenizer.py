@@ -15,6 +15,9 @@ from typing import Sequence
 
 REPLACEMENT_CHAR = "�"
 
+# from_pretrained result cache, keyed by (artifact abspath, mtime).
+_tokenizer_cache: dict = {}
+
 
 @dataclass
 class Encoding:
@@ -41,7 +44,37 @@ class Tokenizer:
         tokenizer.json first, then a bare SentencePiece tokenizer.model
         (``sp_model.py``), then the transformers fallback. A ``.gguf``
         path reconstructs the embedded tokenizer (gguf_tokenizer.rs
-        parity)."""
+        parity).
+
+        Results are cached per (artifact path, mtime): the preprocessor
+        and backend each build a tokenizer from the same card, and for a
+        GGUF that would mean re-decoding a 100k+ string vocab per
+        consumer. The facade is stateless (streaming state lives in
+        DecodeStream), so sharing is safe."""
+        artifact = None
+        if os.path.isfile(path):
+            artifact = path
+        elif os.path.isdir(path):
+            for name in ("tokenizer.json", "tokenizer.model"):
+                cand = os.path.join(path, name)
+                if os.path.exists(cand):
+                    artifact = cand
+                    break
+        key = None
+        if artifact is not None:
+            key = (os.path.abspath(artifact), os.path.getmtime(artifact))
+            hit = _tokenizer_cache.get(key)
+            if hit is not None:
+                return hit
+        tok = cls._load(path)
+        if key is not None:
+            if len(_tokenizer_cache) >= 8:
+                _tokenizer_cache.pop(next(iter(_tokenizer_cache)))
+            _tokenizer_cache[key] = tok
+        return tok
+
+    @classmethod
+    def _load(cls, path: str) -> "Tokenizer":
         eos_ids: list[int] = []
         if path.endswith(".gguf") and os.path.exists(path):
             from .gguf_tokenizer import tokenizer_from_gguf

@@ -2,11 +2,13 @@
 
 Reference parity:
 ``/root/reference/examples/multimodal/components/encode_worker.py:21-60``
-(vision tower + projector on its own GPU, streaming image features to
-the LLM worker). TPU-native: a JAX patch encoder — patchify, linear
-projection, one attention-free mixing layer — standing in for a full
-vision tower; the seam it feeds (``image_features`` consumed as soft
-tokens via ``models/llama.forward(token_embeds=...)``) is the real one.
+(HF vision tower + multi-modal projector on its own device, streaming
+image features to the LLM worker). TPU-native: the tower is the JAX
+CLIP-style ViT in ``dynamo_exp_tpu.models.vision`` — real HF
+CLIPVisionModel safetensors load directly; without a checkpoint a
+random-initialized tower of the same architecture is used. Either way
+the features exit through the real seam: soft tokens consumed by
+``models/llama.forward(token_embeds=...)``.
 """
 
 from __future__ import annotations
@@ -21,41 +23,71 @@ from dynamo_exp_tpu.sdk import async_on_start, endpoint, service
 logger = logging.getLogger(__name__)
 
 
-class PatchEncoder:
-    """Patchify [H, W, 3] → project each patch to the LM hidden size."""
+class VisionEncoder:
+    """CLIP-style ViT + multi-modal projector, one jitted program."""
 
-    def __init__(self, hidden_size: int, patch: int = 16, seed: int = 0):
+    def __init__(
+        self,
+        lm_hidden_size: int,
+        model_path: str = "",
+        image_size: int = 32,
+        patch: int = 8,
+        seed: int = 0,
+    ):
         import jax
-        import jax.numpy as jnp
 
-        self.patch = patch
-        self.hidden = hidden_size
-        k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
-        in_dim = patch * patch * 3
-        self.w_proj = jax.random.normal(
-            k1, (in_dim, hidden_size), jnp.float32
-        ) * (in_dim**-0.5)
-        self.w_mix = jax.random.normal(
-            k2, (hidden_size, hidden_size), jnp.float32
-        ) * (hidden_size**-0.5)
+        from dynamo_exp_tpu.models.vision import (
+            VisionConfig,
+            encode_image,
+            init_projector_params,
+            init_vision_params,
+            load_vision_params,
+        )
 
-        @jax.jit
-        def encode(img):  # [H, W, 3] float32 in [0, 1]
-            H, W, _ = img.shape
-            p = self.patch
-            patches = (
-                img[: H - H % p, : W - W % p]
-                .reshape(H // p, p, W // p, p, 3)
-                .transpose(0, 2, 1, 3, 4)
-                .reshape(-1, p * p * 3)
+        if model_path:
+            self.params, self.cfg = load_vision_params(model_path)
+            if "proj1" not in self.params:
+                # Tower-only checkpoint (plain CLIPVisionModel): attach a
+                # fresh projector into the LM hidden size.
+                import dataclasses
+
+                self.cfg = dataclasses.replace(
+                    self.cfg, projector_dim=lm_hidden_size
+                )
+                self.params.update(
+                    init_projector_params(jax.random.PRNGKey(seed), self.cfg)
+                )
+        else:
+            self.cfg = VisionConfig(
+                hidden_size=64,
+                intermediate_size=128,
+                num_layers=2,
+                num_heads=4,
+                image_size=image_size,
+                patch_size=patch,
+                projector_dim=lm_hidden_size,
             )
-            x = patches @ self.w_proj
-            return x + jnp.tanh(x) @ self.w_mix  # [n_patches, hidden]
+            self.params = init_vision_params(jax.random.PRNGKey(seed), self.cfg)
 
-        self._encode = encode
+        self._encode = jax.jit(
+            lambda pixels: encode_image(self.params, self.cfg, pixels)
+        )
 
     def __call__(self, image: np.ndarray) -> np.ndarray:
-        return np.asarray(self._encode(image.astype(np.float32)))
+        """[H, W, 3] float32 → [n_patches, lm_hidden] soft tokens.
+
+        Any resolution is bilinearly resized to the tower raster (the
+        resize step of the HF CLIP image-processing pipeline), so the
+        whole image contributes — never a top-left crop."""
+        import jax.image
+
+        s = self.cfg.image_size
+        img = image.astype(np.float32)
+        if img.shape[:2] != (s, s):
+            img = np.asarray(
+                jax.image.resize(img, (s, s, img.shape[2]), method="bilinear")
+            )
+        return np.asarray(self._encode(img[None])[0])
 
 
 def decode_image(request: dict) -> np.ndarray:
@@ -69,8 +101,10 @@ def decode_image(request: dict) -> np.ndarray:
 
 @service(dynamo={"namespace": "multimodal"}, resources={"tpu": 1})
 class EncodeWorker:
-    hidden_size: int = 2048
-    patch: int = 16
+    lm_hidden_size: int = 2048
+    model_path: str = ""  # HF CLIPVisionModel / LLaVA checkpoint dir
+    image_size: int = 32
+    patch: int = 8
 
     def __init__(self):
         self.encoder = None
@@ -78,7 +112,12 @@ class EncodeWorker:
 
     @async_on_start
     async def build(self) -> None:
-        self.encoder = PatchEncoder(self.hidden_size, self.patch)
+        self.encoder = VisionEncoder(
+            self.lm_hidden_size,
+            model_path=self.model_path,
+            image_size=self.image_size,
+            patch=self.patch,
+        )
 
     @endpoint()
     async def encode(self, request: dict):

@@ -34,13 +34,74 @@ def test_forward_token_embeds_matches_id_lookup():
     )
 
 
-def test_patch_encoder_shapes():
-    from examples.multimodal.components.encode_worker import PatchEncoder
+def test_vision_encoder_shapes():
+    """Tower + projector emit [num_patches, lm_hidden] soft tokens
+    (padded/cropped to the tower raster)."""
+    from examples.multimodal.components.encode_worker import VisionEncoder
 
-    enc = PatchEncoder(hidden_size=64, patch=8)
+    enc = VisionEncoder(lm_hidden_size=64, image_size=16, patch=8)
     img = np.random.RandomState(0).rand(32, 24, 3)
     out = enc(img)
-    assert out.shape == (4 * 3, 64)  # 32/8 x 24/8 patches
+    assert out.shape == (4, 64)  # (16/8)^2 patches → LM hidden
+
+
+def test_vision_forward_matches_hf_clip(tmp_path):
+    """A tiny random-but-real CLIPVisionModel checkpoint round-trips:
+    save with transformers, load with our safetensors loader, compare
+    last_hidden_state (reference: encode_worker.py:21-60 runs the HF
+    tower; we must produce the same features)."""
+    import torch
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    from dynamo_exp_tpu.models.vision import load_vision_params, vision_forward
+
+    hf_cfg = CLIPVisionConfig(
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        image_size=32,
+        patch_size=8,
+    )
+    torch.manual_seed(0)
+    model = CLIPVisionModel(hf_cfg).eval()
+    d = str(tmp_path / "clip")
+    model.save_pretrained(d, safe_serialization=True)
+
+    params, cfg = load_vision_params(d)
+    img = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    ours = np.asarray(vision_forward(params, cfg, img))
+    with torch.no_grad():
+        theirs = model(
+            pixel_values=torch.from_numpy(img.transpose(0, 3, 1, 2))
+        ).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-5)
+
+
+def test_encode_worker_loads_real_checkpoint(tmp_path):
+    """EncodeWorker with model_path: HF tower weights + attached
+    projector produce LM-hidden soft tokens."""
+    import torch
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    from examples.multimodal.components.encode_worker import VisionEncoder
+
+    hf_cfg = CLIPVisionConfig(
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        image_size=16,
+        patch_size=8,
+    )
+    torch.manual_seed(1)
+    d = str(tmp_path / "clip")
+    CLIPVisionModel(hf_cfg).save_pretrained(d, safe_serialization=True)
+
+    enc = VisionEncoder(lm_hidden_size=64, model_path=d)
+    out = enc(np.random.RandomState(2).rand(16, 16, 3))
+    assert out.shape == (4, 64)
+    assert np.isfinite(out).all()
 
 
 async def test_encode_worker_to_vision_chat_flow():
@@ -50,7 +111,8 @@ async def test_encode_worker_to_vision_chat_flow():
     from examples.multimodal.multimodal_demo import VisionChat
 
     enc = EncodeWorker()
-    enc.hidden_size = 64
+    enc.lm_hidden_size = 64
+    enc.image_size = 16
     enc.patch = 8
     await enc.build()
 
