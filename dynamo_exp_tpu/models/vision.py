@@ -41,6 +41,10 @@ class VisionConfig:
     # Projector to the LM hidden size (LLaVA multi_modal_projector);
     # None = tower only.
     projector_dim: int | None = None
+    # Which encoder layer feeds the projector: -1 = final, -2 = the HF
+    # LLaVA default (vision_feature_layer, penultimate layer) — trained
+    # projectors are distribution-matched to that layer, not the last.
+    feature_layer: int = -1
 
     @property
     def head_dim(self) -> int:
@@ -55,11 +59,13 @@ class VisionConfig:
         """Accepts a CLIPVisionConfig dict, or a full multimodal
         config.json carrying ``vision_config`` (LLaVA-style)."""
         projector_dim = None
+        feature_layer = -1
         if "vision_config" in cfg:
             projector_dim = (
                 cfg.get("text_config", {}).get("hidden_size")
                 or cfg.get("hidden_size")
             )
+            feature_layer = cfg.get("vision_feature_layer", -2)
             cfg = cfg["vision_config"]
         return cls(
             hidden_size=cfg.get("hidden_size", 768),
@@ -72,6 +78,7 @@ class VisionConfig:
             layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
             hidden_act=cfg.get("hidden_act", "quick_gelu"),
             projector_dim=projector_dim,
+            feature_layer=feature_layer,
         )
 
     @classmethod
@@ -187,7 +194,7 @@ def vision_forward(params: dict, cfg: VisionConfig, pixels) -> jnp.ndarray:
         x = x + o @ lp["wo"] + lp["wo_b"]
         y = _ln(x, lp["ln2"], lp["ln2_b"], cfg.layer_norm_eps)
         x = x + act(y @ lp["w1"] + lp["w1_b"]) @ lp["w2"] + lp["w2_b"]
-        return x, None
+        return x, x
 
     layer_params = {
         k: params[k]
@@ -196,8 +203,13 @@ def vision_forward(params: dict, cfg: VisionConfig, pixels) -> jnp.ndarray:
             "wv", "wv_b", "wo", "wo_b", "w1", "w1_b", "w2", "w2_b",
         )
     }
-    x, _ = jax.lax.scan(layer, x, layer_params)
-    return x
+    x, per_layer = jax.lax.scan(layer, x, layer_params)
+    if cfg.feature_layer == -1:
+        return x
+    # HF hidden_states[k] for k >= 1 is the output of layer k-1;
+    # per_layer[j] is the output of layer j, so a negative
+    # vision_feature_layer index maps directly onto per_layer.
+    return per_layer[cfg.feature_layer]
 
 
 def select_patch_features(hidden: jnp.ndarray) -> jnp.ndarray:

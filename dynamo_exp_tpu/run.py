@@ -338,20 +338,31 @@ async def run_worker(opts, drt, core, tpu_engine, mdc=None):
                 metrics_pub.update(ForwardPassMetrics.from_dict(tpu_engine.metrics()))
 
         drt.runtime.spawn(pump_metrics())
-    if opts.model_path and mdc is not None:
-        await register_llm(
-            drt, ep, opts.model_path, opts.model_name or None,
-            kv_cache_block_size=opts.page_size,
-        )
-    elif opts.model_path:
+    if opts.model_path:
         # A tokenizer-less artifact (weights-only GGUF) must not be
         # advertised to OpenAI ingress: the frontend would loop forever
-        # failing to build a preprocessor chain from its card.
-        logger.warning(
-            "not registering %s with ingress: no tokenizer available "
-            "(token-level clients can still target this endpoint directly)",
-            opts.model_path,
-        )
+        # failing to build a preprocessor chain from its card. Model
+        # dirs always carry a tokenizer; GGUFs only sometimes do (when
+        # the tpu engine built an mdc we already know the answer).
+        registrable = True
+        if opts.model_path.endswith(".gguf") and mdc is None:
+            from .models.gguf import GGUFFile
+
+            registrable = (
+                "tokenizer.ggml.tokens" in GGUFFile.parse(opts.model_path).metadata
+            )
+        if registrable:
+            await register_llm(
+                drt, ep, opts.model_path, opts.model_name or None,
+                kv_cache_block_size=opts.page_size,
+            )
+        else:
+            logger.warning(
+                "not registering %s with ingress: no tokenizer available "
+                "(token-level clients can still target this endpoint "
+                "directly)",
+                opts.model_path,
+            )
     print(f"worker serving {opts.input} (instance {served.instance_id})", flush=True)
     try:
         await drt.runtime.primary_token.cancelled()

@@ -51,18 +51,32 @@ class Tokenizer:
         GGUF that would mean re-decoding a 100k+ string vocab per
         consumer. The facade is stateless (streaming state lives in
         DecodeStream), so sharing is safe."""
-        artifact = None
+        artifacts = []
         if os.path.isfile(path):
-            artifact = path
+            artifacts = [path]
         elif os.path.isdir(path):
             for name in ("tokenizer.json", "tokenizer.model"):
                 cand = os.path.join(path, name)
                 if os.path.exists(cand):
-                    artifact = cand
+                    artifacts = [cand]
                     break
+            if artifacts:
+                # The loaded result also depends on these sidecars (eos
+                # ids, add_bos) — key on their mtimes too, so editing
+                # generation_config.json invalidates the cache.
+                for name in (
+                    "config.json",
+                    "generation_config.json",
+                    "tokenizer_config.json",
+                ):
+                    cand = os.path.join(path, name)
+                    if os.path.exists(cand):
+                        artifacts.append(cand)
         key = None
-        if artifact is not None:
-            key = (os.path.abspath(artifact), os.path.getmtime(artifact))
+        if artifacts:
+            key = tuple(
+                (os.path.abspath(a), os.path.getmtime(a)) for a in artifacts
+            )
             hit = _tokenizer_cache.get(key)
             if hit is not None:
                 return hit

@@ -69,16 +69,26 @@ class VisionEncoder:
             )
             self.params = init_vision_params(jax.random.PRNGKey(seed), self.cfg)
 
+        # params passed as an argument (not closed over): closure-
+        # captured weights would be baked into the executable as
+        # constants, doubling memory for a real tower.
+        cfg = self.cfg
         self._encode = jax.jit(
-            lambda pixels: encode_image(self.params, self.cfg, pixels)
+            lambda params, pixels: encode_image(params, cfg, pixels)
         )
 
-    def __call__(self, image: np.ndarray) -> np.ndarray:
-        """[H, W, 3] float32 → [n_patches, lm_hidden] soft tokens.
+    # CLIP training-time channel statistics (HF CLIPImageProcessor
+    # defaults): real checkpoints expect normalized pixels.
+    _MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+    _STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
 
-        Any resolution is bilinearly resized to the tower raster (the
-        resize step of the HF CLIP image-processing pipeline), so the
-        whole image contributes — never a top-left crop."""
+    def __call__(self, image: np.ndarray) -> np.ndarray:
+        """[H, W, 3] float32 in [0, 1] → [n_patches, lm_hidden] soft
+        tokens.
+
+        Preprocessing mirrors the HF CLIP pipeline's shape: bilinear
+        resize to the tower raster (the whole image contributes — never
+        a top-left crop), then per-channel mean/std normalization."""
         import jax.image
 
         s = self.cfg.image_size
@@ -87,7 +97,8 @@ class VisionEncoder:
             img = np.asarray(
                 jax.image.resize(img, (s, s, img.shape[2]), method="bilinear")
             )
-        return np.asarray(self._encode(img[None])[0])
+        img = (img - self._MEAN) / self._STD
+        return np.asarray(self._encode(self.params, img[None])[0])
 
 
 def decode_image(request: dict) -> np.ndarray:

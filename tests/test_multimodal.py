@@ -139,3 +139,38 @@ async def test_encode_worker_to_vision_chat_flow():
     assert results
     assert results[0]["n_image_tokens"] == 4  # 16/8 x 16/8
     assert 0 <= results[0]["next_token"] < TINY.vocab_size
+
+
+def test_vision_feature_layer_matches_hf_hidden_states(tmp_path):
+    """LLaVA's vision_feature_layer=-2 selects the penultimate layer;
+    our scan-collected per-layer outputs must match HF hidden_states."""
+    import dataclasses
+
+    import torch
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    from dynamo_exp_tpu.models.vision import load_vision_params, vision_forward
+
+    hf_cfg = CLIPVisionConfig(
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        image_size=16,
+        patch_size=8,
+    )
+    torch.manual_seed(2)
+    model = CLIPVisionModel(hf_cfg).eval()
+    d = str(tmp_path / "clip")
+    model.save_pretrained(d, safe_serialization=True)
+
+    params, cfg = load_vision_params(d)
+    cfg = dataclasses.replace(cfg, feature_layer=-2)
+    img = np.random.RandomState(1).rand(1, 16, 16, 3).astype(np.float32)
+    ours = np.asarray(vision_forward(params, cfg, img))
+    with torch.no_grad():
+        hs = model(
+            pixel_values=torch.from_numpy(img.transpose(0, 3, 1, 2)),
+            output_hidden_states=True,
+        ).hidden_states
+    np.testing.assert_allclose(ours, hs[-2].numpy(), atol=2e-5)
