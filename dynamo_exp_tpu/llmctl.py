@@ -153,7 +153,42 @@ def build_parser() -> argparse.ArgumentParser:
     rm = hsub.add_parser("remove")
     rm.add_argument("model_type", choices=sorted(_TYPES))
     rm.add_argument("model_name")
+
+    # Live disagg-router reconfiguration (reference: DisaggRouterConf in
+    # etcd with a watch, disagg_router.rs:24-262). ``set`` takes effect
+    # on running decode workers within one watch push — no restarts.
+    disagg = sub.add_parser(
+        "disagg", help="conditional disagg-router config (live-watched)"
+    )
+    dsub = disagg.add_subparsers(dest="command", required=True)
+    dget = dsub.add_parser("get")
+    dget.add_argument("model_name")
+    dset = dsub.add_parser("set")
+    dset.add_argument("model_name")
+    dset.add_argument("--max-local-prefill-length", type=int, required=True)
+    dset.add_argument("--max-prefill-queue-size", type=int, default=2)
     return p
+
+
+async def get_disagg(drt, args) -> int:
+    from .disagg.config import DisaggConfig, disagg_config_key
+
+    raw = await drt.discovery.kv_get(disagg_config_key(args.model_name))
+    cfg = DisaggConfig.from_bytes(raw) if raw else DisaggConfig()
+    print(json.dumps({"model": args.model_name, **cfg.__dict__}, indent=2))
+    return 0
+
+
+async def set_disagg(drt, args) -> int:
+    from .disagg.config import DisaggConfig, disagg_config_key
+
+    cfg = DisaggConfig(
+        max_local_prefill_length=args.max_local_prefill_length,
+        max_prefill_queue_size=args.max_prefill_queue_size,
+    )
+    await drt.discovery.kv_put(disagg_config_key(args.model_name), cfg.to_bytes())
+    print(f"disagg config for {args.model_name} updated: {cfg}")
+    return 0
 
 
 async def run(args) -> int:
@@ -164,6 +199,10 @@ async def run(args) -> int:
         config=RuntimeConfig(coordinator_endpoint=args.coordinator)
     )
     try:
+        if args.plane == "disagg":
+            if args.command == "get":
+                return await get_disagg(drt, args)
+            return await set_disagg(drt, args)
         if args.command == "add":
             return await add_model(drt, args)
         if args.command == "list":

@@ -204,12 +204,20 @@ def vision_forward(params: dict, cfg: VisionConfig, pixels) -> jnp.ndarray:
         )
     }
     x, per_layer = jax.lax.scan(layer, x, layer_params)
-    if cfg.feature_layer == -1:
+    fl = cfg.feature_layer
+    if fl == -1:
         return x
-    # HF hidden_states[k] for k >= 1 is the output of layer k-1;
-    # per_layer[j] is the output of layer j, so a negative
-    # vision_feature_layer index maps directly onto per_layer.
-    return per_layer[cfg.feature_layer]
+    # HF vision_feature_layer indexes ``hidden_states``, which includes
+    # the embeddings at index 0: hidden_states[k] (k>=1) is the output
+    # of layer k-1 = per_layer[k-1]; negative indices line up directly
+    # (hidden_states[-k] = per_layer[-k] for k <= num_layers).
+    if fl == 0 or fl < -cfg.num_layers:
+        raise ValueError(
+            f"vision feature_layer {fl} selects the embeddings, which "
+            "this tower does not expose (supported: -num_layers..-1, "
+            "1..num_layers)"
+        )
+    return per_layer[fl - 1] if fl > 0 else per_layer[fl]
 
 
 def select_patch_features(hidden: jnp.ndarray) -> jnp.ndarray:

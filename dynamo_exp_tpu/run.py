@@ -344,12 +344,28 @@ async def run_worker(opts, drt, core, tpu_engine, mdc=None):
         # failing to build a preprocessor chain from its card. Model
         # dirs always carry a tokenizer; GGUFs only sometimes do (when
         # the tpu engine built an mdc we already know the answer).
-        registrable = True
-        if opts.model_path.endswith(".gguf") and mdc is None:
-            from .models.gguf import GGUFFile
+        if mdc is not None:
+            registrable = True
+        elif opts.model_path.endswith(".gguf"):
+            if opts.output == "tpu":
+                # build_tpu_engine already parsed this GGUF: mdc is None
+                # exactly because it has no embedded tokenizer — don't
+                # re-parse a multi-GB file to re-derive that.
+                registrable = False
+            else:
+                from .models.gguf import GGUFFile
 
-            registrable = (
-                "tokenizer.ggml.tokens" in GGUFFile.parse(opts.model_path).metadata
+                registrable = (
+                    "tokenizer.ggml.tokens"
+                    in GGUFFile.parse(opts.model_path).metadata
+                )
+        else:
+            # Model dir: probe for an actual tokenizer artifact instead
+            # of assuming — a weights-only dir registered here would
+            # strand ingress in a rebuild loop.
+            registrable = any(
+                os.path.exists(os.path.join(opts.model_path, name))
+                for name in ("tokenizer.json", "tokenizer.model")
             )
         if registrable:
             await register_llm(

@@ -1,0 +1,36 @@
+"""Disaggregated serving with KV-aware routing: the full fleet shape.
+
+Reference parity: ``/root/reference/examples/llm/graphs/disagg_router.py:16-22``
+(Frontend → Processor → Router → Worker ⇢ PrefillWorker). Two routing
+layers compose here:
+
+- the Processor's **KV router** (``router: kv`` in the config) picks the
+  decode worker with the longest cached prefix;
+- each decode worker's **conditional disagg router** (live-watched
+  ``DisaggConfig``; retune at runtime with
+  ``llmctl disagg set <model> --max-local-prefill-length N``) decides
+  per-request whether the prefill runs locally or on the prefill fleet.
+
+    python -m dynamo_exp_tpu.sdk.serve \
+        examples.llm.graphs.disagg_router:Graph \
+        -f examples/llm/configs/disagg_router.yaml --start-coordinator
+"""
+
+from dynamo_exp_tpu.sdk import depends, service
+
+from examples.llm.components.frontend import Frontend
+from examples.llm.components.prefill_worker import PrefillTpuWorker
+from examples.llm.components.processor import Processor
+from examples.llm.components.worker import TpuWorker
+
+
+@service(dynamo={"namespace": "dynamo"})
+class Graph:
+    """Root tying the HTTP ingress to both fleets (edges exist for graph
+    discovery; neither client is ever called)."""
+
+    frontend = depends(Frontend)
+    prefill = depends(PrefillTpuWorker, endpoint="pull")
+
+
+__all__ = ["Graph", "Frontend", "Processor", "TpuWorker", "PrefillTpuWorker"]
