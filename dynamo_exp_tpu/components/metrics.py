@@ -26,6 +26,7 @@ from prometheus_client import (
 
 from ..http.metrics import CONTENT_TYPE_LATEST
 from ..kv_router.metrics_aggregator import KvMetricsAggregator
+from ..telemetry import get_telemetry
 from ..kv_router.protocols import KV_HIT_RATE_SUBJECT
 from ..runtime.component import Component
 
@@ -164,13 +165,15 @@ class MetricsService:
         # charset=utf-8" — aiohttp wants content_type and charset split.
         ctype, _, _ = CONTENT_TYPE_LATEST.partition(";")
         return web.Response(
-            body=generate_latest(self.registry),
+            body=self.render(),
             content_type=ctype.strip(),
             charset="utf-8",
         )
 
     def render(self) -> bytes:
-        return generate_latest(self.registry)
+        # Unified scrape: aggregator gauges + the process-wide telemetry
+        # registry (stage histograms, engine gauges, transfer metrics).
+        return generate_latest(self.registry) + get_telemetry().render()
 
     async def stop(self) -> None:
         for t in (self._hit_task, self._export_task):

@@ -127,17 +127,24 @@ class KubectlBackend(ClusterBackend):
             raise RuntimeError(f"kubectl {' '.join(args)}: {err.decode()}")
         return out.decode()
 
-    def _decorate(self, deployment: str, doc: dict) -> dict:
+    def _decorate(self, deployment: str, doc: dict, content_hash: str) -> dict:
         meta = doc.setdefault("metadata", {})
         labels = meta.setdefault("labels", {})
         labels["app.kubernetes.io/managed-by"] = "dynamo-exp-tpu-operator"
         labels["dynamo-exp-tpu/deployment"] = deployment
-        meta.setdefault("annotations", {})[self.HASH_ANNOTATION] = _doc_hash(doc)
+        meta.setdefault("annotations", {})[self.HASH_ANNOTATION] = content_hash
         return doc
 
     async def apply(self, deployment: str, doc: dict) -> None:
+        # Annotate with the hash of the doc AS RENDERED, before
+        # _decorate adds the ownership labels: the reconciler diffs
+        # list_applied() hashes against _doc_hash(rendered doc), so
+        # hashing the decorated doc would mismatch every pass and
+        # re-apply the whole graph forever.
+        content_hash = _doc_hash(doc)
         await self._run(
-            "apply", "-f", "-", stdin=yaml.safe_dump(self._decorate(deployment, doc))
+            "apply", "-f", "-",
+            stdin=yaml.safe_dump(self._decorate(deployment, doc, content_hash)),
         )
 
     async def delete(self, deployment: str, key: tuple[str, str]) -> None:

@@ -17,6 +17,7 @@ from ..engine.scheduler import RemoteKv
 from ..protocols.common import BackendInput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from ..runtime.transports.base import WorkQueue
+from ..telemetry import span as trace_span
 from .config import DisaggConfigWatcher
 from .protocol import RemotePrefillRequest, kv_signature
 from .transfer import KvPageReceiver
@@ -78,27 +79,41 @@ class DisaggDecodeEngine(AsyncEngine):
 
         rid = ctx.id
         fut = self.receiver.expect(rid)
-        req = RemotePrefillRequest(
-            request_id=rid,
-            token_ids=list(binput.token_ids),
-            return_addr=self.receiver.address,
-            sampling_options=binput.sampling_options.model_dump(exclude_none=True),
-            page_size=self.engine.cfg.page_size,
-            model=kv_signature(self.engine.cfg),
-        )
-        try:
-            await self.queue.push(req.to_bytes())
-            first_token, pages = await asyncio.wait_for(
-                fut, timeout=self.transfer_timeout_s
+        with trace_span(
+            "remote_prefill", request_id=rid, prompt_tokens=len(binput.token_ids)
+        ) as sp:
+            # The span's own context rides the queue, so the prefill
+            # worker's spans (engine queue wait, prefill compute, KV
+            # transfer send) land under this node of the trace.
+            req = RemotePrefillRequest(
+                request_id=rid,
+                token_ids=list(binput.token_ids),
+                return_addr=self.receiver.address,
+                sampling_options=binput.sampling_options.model_dump(
+                    exclude_none=True
+                ),
+                page_size=self.engine.cfg.page_size,
+                model=kv_signature(self.engine.cfg),
+                trace_id=sp.context.trace_id,
+                parent_span_id=sp.context.span_id,
             )
-            self._check_page_shapes(pages, len(binput.token_ids))
-            self.remote_prefills += 1
-            return RemoteKv(first_token=first_token, pages=pages)
-        except Exception:  # noqa: BLE001 - remote prefill is best-effort
-            logger.exception("remote prefill failed for %s; prefilling locally", rid)
-            self.receiver.forget(rid)
-            self.local_fallbacks += 1
-            return None
+            try:
+                await self.queue.push(req.to_bytes())
+                first_token, pages = await asyncio.wait_for(
+                    fut, timeout=self.transfer_timeout_s
+                )
+                self._check_page_shapes(pages, len(binput.token_ids))
+                self.remote_prefills += 1
+                sp.set(outcome="remote")
+                return RemoteKv(first_token=first_token, pages=pages)
+            except Exception:  # noqa: BLE001 - remote prefill is best-effort
+                logger.exception(
+                    "remote prefill failed for %s; prefilling locally", rid
+                )
+                self.receiver.forget(rid)
+                self.local_fallbacks += 1
+                sp.set(outcome="local_fallback")
+                return None
 
     def _check_page_shapes(self, pages: list, prompt_len: int) -> None:
         """Last line of defense: a wrong-shaped or short transfer must

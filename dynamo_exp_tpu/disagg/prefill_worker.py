@@ -15,6 +15,7 @@ from ..engine.engine import TPUEngine
 from ..protocols.common import BackendInput, SamplingOptions
 from ..runtime.runtime import CancellationToken
 from ..runtime.transports.base import WorkQueue
+from ..telemetry import TraceContext, adopt
 from .protocol import RemotePrefillRequest, kv_signature
 from .transfer import send_kv_pages
 
@@ -84,26 +85,35 @@ class PrefillWorker:
         if req.model and req.model != kv_signature(self.engine.cfg):
             await self._fail(req, "KV layout mismatch between fleets")
             return
-        try:
-            binput = BackendInput(
-                token_ids=req.token_ids,
-                sampling_options=SamplingOptions(**req.sampling_options),
-            )
-            first_token, pages = await self.engine.prefill_extract(binput)
-        except Exception as e:  # noqa: BLE001 - report upstream, keep serving
-            logger.exception("prefill failed for %s", req.request_id)
-            await self._fail(req, f"{type(e).__name__}: {e}")
-            return
-        try:
-            await send_kv_pages(req.return_addr, req.request_id, first_token, pages)
-            self.served += 1
-        except Exception:  # noqa: BLE001 - a delivery failure (decode worker
-            # died, dropped the connection pre-ack, …) must never kill the
-            # pull loop; the decode side times out and prefills locally.
-            logger.warning(
-                "KV delivery failed for %s", req.request_id, exc_info=True
-            )
-            self.failed += 1
+        # Continue the decode worker's trace: spans emitted while serving
+        # (engine queue wait + prefill compute, KV transfer send) and any
+        # JSONL log lines parent into the request's trace tree.
+        trace = TraceContext.from_wire(
+            {"trace_id": req.trace_id, "parent_span_id": req.parent_span_id}
+        )
+        with adopt(trace):
+            try:
+                binput = BackendInput(
+                    token_ids=req.token_ids,
+                    sampling_options=SamplingOptions(**req.sampling_options),
+                )
+                first_token, pages = await self.engine.prefill_extract(binput)
+            except Exception as e:  # noqa: BLE001 - report upstream, keep serving
+                logger.exception("prefill failed for %s", req.request_id)
+                await self._fail(req, f"{type(e).__name__}: {e}")
+                return
+            try:
+                await send_kv_pages(
+                    req.return_addr, req.request_id, first_token, pages
+                )
+                self.served += 1
+            except Exception:  # noqa: BLE001 - a delivery failure (decode worker
+                # died, dropped the connection pre-ack, …) must never kill the
+                # pull loop; the decode side times out and prefills locally.
+                logger.warning(
+                    "KV delivery failed for %s", req.request_id, exc_info=True
+                )
+                self.failed += 1
 
     async def _fail(self, req: RemotePrefillRequest, error: str) -> None:
         self.failed += 1

@@ -40,10 +40,24 @@ class RemotePrefillRequest:
     # Sanity guards: both engines must agree on the KV layout.
     page_size: int = 0
     model: str = ""
+    # Trace continuation: the decode worker's trace context rides the
+    # queue so the prefill worker's spans (queue wait, prefill compute,
+    # KV transfer send) join the request's trace. A request from an
+    # older sender (fields absent) simply starts its own trace on the
+    # worker; the reverse skew (new decode fleet, old prefill fleet)
+    # requires upgrading prefill workers first — pre-trace from_bytes
+    # rejects unknown fields.
+    trace_id: str = ""
+    parent_span_id: str = ""
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "RemotePrefillRequest":
-        return cls(**json.loads(raw))
+        # Ignore unknown keys so future protocol additions (the next
+        # trace_id) don't make this worker drop requests from newer
+        # decode fleets mid-rollout.
+        d = json.loads(raw)
+        known = {f.name for f in cls.__dataclass_fields__.values()}
+        return cls(**{k: v for k, v in d.items() if k in known})

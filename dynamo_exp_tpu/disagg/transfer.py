@@ -27,6 +27,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import time
 
 import jax.numpy as jnp
 import numpy as np
@@ -37,6 +38,7 @@ from ..runtime.transports.codec import (
     read_message,
     write_message,
 )
+from ..telemetry import TraceContext, current_trace, get_telemetry, wire_headers
 
 logger = logging.getLogger(__name__)
 
@@ -99,7 +101,16 @@ async def send_kv_pages(
     and arrival overlaps transmission.
     """
     host, _, port = return_addr.rpartition(":")
-    reader, writer = await asyncio.open_connection(host or "127.0.0.1", int(port))
+    t0 = time.time()
+    total_bytes = 0
+    tel = get_telemetry()
+    try:
+        reader, writer = await asyncio.open_connection(
+            host or "127.0.0.1", int(port)
+        )
+    except BaseException:
+        tel.kv_transfer_total.labels("send", "error").inc()
+        raise
 
     async def _read_ack() -> None:
         """An ack that is an ERROR frame (or ok=False) means the receiver
@@ -133,6 +144,10 @@ async def send_kv_pages(
             "n_pages": len(pages),
             "n_chunks": len(chunks),
         }
+        # The receiver's transfer span joins the sender's trace.
+        trace = wire_headers()
+        if trace:
+            begin["trace"] = trace
         await write_message(writer, TwoPartMessage(MsgType.FRAME, begin))
         unacked = 0
         for idx, chunk in enumerate(chunks):
@@ -143,6 +158,7 @@ async def send_kv_pages(
             await write_message(
                 writer, TwoPartMessage(MsgType.FRAME, header, payload)
             )
+            total_bytes += len(payload)
             unacked += 1
             if unacked >= window:
                 await _read_ack()  # per-chunk ack
@@ -159,6 +175,22 @@ async def send_kv_pages(
         # Final ack: pages are known-delivered before the prefill worker
         # releases/reuses its device pages.
         await _read_ack()
+        end = time.time()
+        tel.kv_transfer_duration.labels("send").observe(end - t0)
+        tel.kv_transfer_bytes.labels("send").observe(total_bytes)
+        tel.kv_transfer_total.labels("send", "ok").inc()
+        tel.emit_stage(
+            "kv_transfer_send",
+            t0,
+            end,
+            current_trace(),
+            request_id=request_id,
+            pages=len(pages),
+            bytes=total_bytes,
+        )
+    except BaseException:
+        tel.kv_transfer_total.labels("send", "error").inc()
+        raise
     finally:
         writer.close()
         with contextlib.suppress(Exception):
@@ -238,13 +270,19 @@ class KvPageReceiver:
                 )
             elif msg.header.get("kind") == "begin":
                 first_token = msg.header["first_token"]
+                t0 = time.time()
+                n_bytes = 0
+                n_pages = 0
+                trace = TraceContext.from_wire(msg.header.get("trace"))
                 on_chunk = self._chunk_cbs.pop(rid, None)
                 pages: list = []
                 while True:
                     msg = await read_message(reader)
                     if msg.header.get("kind") == "end":
                         break
+                    n_bytes += len(msg.payload or b"")
                     chunk = decode_pages(msg.header, msg.payload)
+                    n_pages += len(chunk)
                     if on_chunk is not None:
                         # Streaming consumer: pages leave through the
                         # callback as they land (the receiver-side
@@ -257,6 +295,20 @@ class KvPageReceiver:
                         writer, TwoPartMessage(MsgType.COMPLETE, {"ok": True})
                     )
                 fut.set_result((first_token, pages))
+                end = time.time()
+                tel = get_telemetry()
+                tel.kv_transfer_duration.labels("recv").observe(end - t0)
+                tel.kv_transfer_bytes.labels("recv").observe(n_bytes)
+                tel.kv_transfer_total.labels("recv", "ok").inc()
+                tel.emit_stage(
+                    "kv_transfer_recv",
+                    t0,
+                    end,
+                    trace,
+                    request_id=rid,
+                    pages=n_pages,
+                    bytes=n_bytes,
+                )
             else:
                 # Unchunked single-frame transfers are rejected outright:
                 # one frame would buffer the whole KV payload (hundreds of
@@ -267,6 +319,7 @@ class KvPageReceiver:
                     "unchunked KV transfer frame rejected (sender too "
                     "old: expected begin/data/end chunk protocol)"
                 )
+                get_telemetry().kv_transfer_total.labels("recv", "error").inc()
                 fut.set_exception(RuntimeError(err))
                 # The sender treats the final ack as proof of delivery
                 # before releasing its device pages — it must see the
@@ -281,8 +334,12 @@ class KvPageReceiver:
         except (asyncio.IncompleteReadError, ConnectionError) as e:
             # A connection drop mid-transfer must fail the waiting
             # request immediately: the future was already popped from
-            # _pending, so close() can no longer reach it.
+            # _pending, so close() can no longer reach it. Count the
+            # error only for a real in-flight transfer — port scanners
+            # connecting and hanging up (fut None), or a post-outcome
+            # write failure (fut already done), must not skew the rate.
             if fut is not None and not fut.done():
+                get_telemetry().kv_transfer_total.labels("recv", "error").inc()
                 fut.set_exception(
                     ConnectionError(f"KV transfer dropped mid-stream: {e}")
                 )
@@ -290,6 +347,7 @@ class KvPageReceiver:
             # the waiting request *now*, not leave it to time out.
             logger.exception("bad KV transfer frame")
             if fut is not None and not fut.done():
+                get_telemetry().kv_transfer_total.labels("recv", "error").inc()
                 fut.set_exception(RuntimeError(f"bad KV transfer frame: {e}"))
         finally:
             self._chunk_cbs.pop(rid, None)

@@ -34,6 +34,7 @@ import asyncio
 import logging
 import queue
 import threading
+import time
 from functools import partial
 from typing import AsyncIterator, Callable
 
@@ -56,6 +57,7 @@ from ..ops.sampling import apply_penalties, sample_tokens, token_logprobs
 from ..parallel.mesh import build_mesh
 from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
+from ..telemetry import current_trace, get_telemetry
 from .config import EngineConfig
 from .kv_manager import KvEvent, KvPageManager
 from .offload import CopyStream, HostKvPool
@@ -163,6 +165,7 @@ class TPUEngine(AsyncEngine):
         self._running = False
         self._thread: threading.Thread | None = None
         self.steps = 0  # decode step counter (metrics)
+        self._last_gauge_pub = 0.0  # telemetry gauge throttle
 
     # ----------------------------------------------------------- compiled fns
     def _resolve_attn(self) -> tuple[str, bool]:
@@ -385,6 +388,8 @@ class TPUEngine(AsyncEngine):
             emit=emit,
             is_cancelled=lambda: ctx.is_stopped,
             remote_kv=remote_kv,
+            trace=current_trace(),
+            submitted_at=time.time(),
         )
         self._submit_q.put(seq)
         self._wake.set()
@@ -457,6 +462,8 @@ class TPUEngine(AsyncEngine):
             emit=emit,
             is_cancelled=lambda: ctx.is_stopped,
             extract_cb=extract_cb,
+            trace=current_trace(),
+            submitted_at=time.time(),
         )
         self._submit_q.put(seq)
         self._wake.set()
@@ -472,13 +479,18 @@ class TPUEngine(AsyncEngine):
         try:
             while self._running:
                 if not self.sched.has_work() and self._submit_q.empty():
+                    # Publish on the idle path too: the gauges must decay
+                    # to zero after the last request finishes, not freeze
+                    # on the final busy-loop snapshot.
+                    self._maybe_publish_gauges()
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
                 self._drain_submissions()
                 self._poll_cancellations()
-                while self.sched.admit_next() is not None:
-                    pass
+                while (admitted := self.sched.admit_next()) is not None:
+                    self._on_admitted(admitted)
+                self._maybe_publish_gauges()
                 progressed = False
                 prefilling = [
                     s
@@ -510,6 +522,31 @@ class TPUEngine(AsyncEngine):
             self._running = False
             self._fail_all()
             raise
+
+    def _on_admitted(self, seq: Sequence) -> None:
+        """Close the request's queue-wait stage (submission -> slot +
+        pages bound). Runs on the engine loop thread with the trace
+        captured at submission."""
+        now = time.time()
+        seq.admitted_at = now
+        tel = get_telemetry()
+        if seq.submitted_at:
+            tel.queue_wait.observe(max(now - seq.submitted_at, 0.0))
+            tel.emit_stage(
+                "queue_wait",
+                seq.submitted_at,
+                now,
+                seq.trace,
+                prompt_tokens=len(seq.prompt),
+            )
+
+    def _maybe_publish_gauges(self) -> None:
+        """Mirror engine gauges into the telemetry registry at most
+        ~2x/second — the loop can spin thousands of times faster."""
+        now = time.monotonic()
+        if now - self._last_gauge_pub >= 0.5:
+            self._last_gauge_pub = now
+            get_telemetry().publish_engine_gauges(self.metrics())
 
     def _drain_submissions(self) -> None:
         while True:
@@ -573,6 +610,20 @@ class TPUEngine(AsyncEngine):
         and promote the sequence to decode. ``lp_pack`` is None on the
         remote-KV path — the first token was sampled on the prefill
         worker, which doesn't ship its distribution."""
+        now = time.time()
+        seq.first_token_at = seq.last_emit_at = now
+        tel = get_telemetry()
+        start = seq.admitted_at or seq.submitted_at or now
+        tel.prefill_compute.observe(max(now - start, 0.0))
+        tel.emit_stage(
+            "prefill",
+            start,
+            now,
+            seq.trace,
+            prompt_tokens=len(seq.prompt),
+            cached_tokens=seq.cached_len,
+            remote=seq.remote_prefilled or None,
+        )
         seq.state = SeqState.ACTIVE
         self._counts = self._init_row(self._counts, seq.slot, token)
         seq.tokens.append(token)
@@ -615,6 +666,7 @@ class TPUEngine(AsyncEngine):
                 jnp.asarray(hv),
             )
         seq.remote_kv = None  # drop the host copy the moment it's injected
+        seq.remote_prefilled = True
         self._finish_first_token(seq, rk.first_token)
 
     def _run_prefill_chunk(self, batch: list[Sequence]) -> None:
@@ -799,6 +851,12 @@ class TPUEngine(AsyncEngine):
                     top_ids[:n, seq.slot],
                     top_lps[:n, seq.slot],
                 )
+            if kept:
+                now = time.time()
+                if seq.last_emit_at:
+                    tbt = max(now - seq.last_emit_at, 0.0) / len(kept)
+                    get_telemetry().time_between_tokens.observe(tbt)
+                seq.last_emit_at = now
             seq.emit(kept, None, pack)
             if reason is not None:
                 self.sched.finish(seq, reason)

@@ -103,6 +103,10 @@ class KvPageManager:
         # Metrics counters.
         self.hits = 0
         self.misses = 0
+        # G2 (host offload tier) hit/miss: of the pages a prompt needed
+        # beyond its G1 device match, how many the host tier supplied.
+        self.offload_hits = 0
+        self.offload_misses = 0
 
     # ---------------------------------------------------------------- stats
     @property
@@ -120,6 +124,17 @@ class KvPageManager:
     def hit_rate(self) -> float:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
+
+    def offload_hit_rate(self) -> float:
+        total = self.offload_hits + self.offload_misses
+        return self.offload_hits / total if total else 0.0
+
+    def gauges(self) -> dict:
+        """Engine-level KV gauges for the telemetry registry."""
+        return {
+            "hbm_page_occupancy": self.usage,
+            "offload_hit_rate": self.offload_hit_rate(),
+        }
 
     # ------------------------------------------------------------ allocation
     def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], list[int]]:
@@ -201,6 +216,9 @@ class KvPageManager:
         ]
         self.hits += len(matched_pages) + len(host_pages)
         self.misses += need_fresh - len(host_pages)
+        if self.host_pool is not None:
+            self.offload_hits += len(host_pages)
+            self.offload_misses += need_fresh - len(host_pages)
         cached = (len(matched_pages) + len(host_pages)) * ps
         return Allocation(matched_pages + fresh, cached, uploads, hashes)
 

@@ -18,6 +18,7 @@ until their first token is sampled.
 from __future__ import annotations
 
 import enum
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable
@@ -26,6 +27,7 @@ if TYPE_CHECKING:
     import numpy as np
 
 from ..protocols.common import BackendInput, FinishReason
+from ..telemetry import get_telemetry
 from ..tokens import chain_hash, compute_block_hash
 from .config import EngineConfig
 from .kv_manager import KvPageManager
@@ -89,6 +91,16 @@ class Sequence:
     # after prefill, gather the prompt's KV pages and hand them here as
     # (first_token, [(k_page, v_page), ...]).
     extract_cb: "Callable[[int, list], None] | None" = None
+    # Telemetry: the request's trace context (captured from the
+    # submitting task's contextvar — the engine loop thread doesn't
+    # share it) plus unix-time stage stamps the engine fills in.
+    trace: "object | None" = None
+    submitted_at: float = 0.0
+    admitted_at: float = 0.0
+    first_token_at: float = 0.0
+    last_emit_at: float = 0.0
+    # Set when the prompt KV was injected from a remote prefill worker.
+    remote_prefilled: bool = False
 
     @property
     def pos(self) -> int:
@@ -224,6 +236,20 @@ class Scheduler:
         if seq.state == SeqState.FINISHED:
             return
         was_bound = seq.state in (SeqState.PREFILL, SeqState.ACTIVE)
+        if seq.first_token_at and seq.extract_cb is None:
+            # Close the request's decode span (first token -> finish).
+            # Extract-mode sequences (disagg prefill workers) never
+            # decode — their work ends at the first token.
+            # Runs on the engine loop thread, so the trace context is
+            # the one captured at submission, not a contextvar.
+            get_telemetry().emit_stage(
+                "decode",
+                seq.first_token_at,
+                time.time(),
+                seq.trace,
+                generated_tokens=seq.generated,
+                finish_reason=getattr(reason, "value", str(reason)),
+            )
         seq.state = SeqState.FINISHED
         if seq.slot >= 0 and was_bound:
             self.slots[seq.slot] = None
@@ -252,6 +278,9 @@ class Scheduler:
     def metrics(self) -> dict:
         """ForwardPassMetrics equivalent (reference:
         ``lib/llm/src/kv_router/protocols.rs:43-55``)."""
+        running = sum(
+            1 for s in self.slots if s is not None and s.state is SeqState.ACTIVE
+        )
         return {
             "request_active_slots": self.active_count,
             "request_total_slots": self.cfg.max_decode_slots,
@@ -263,4 +292,9 @@ class Scheduler:
             "num_requests_waiting": len(self.waiting),
             "gpu_cache_usage_perc": self.kv.usage,
             "gpu_prefix_cache_hit_rate": self.kv.hit_rate(),
+            # Engine-level gauges (telemetry): scheduler depth and decode
+            # batch fill; the KV-tier gauges ride in via kv.gauges().
+            "num_requests_running": running,
+            "decode_batch_utilization": running / max(self.cfg.max_decode_slots, 1),
+            **self.kv.gauges(),
         }

@@ -104,6 +104,24 @@ class ModelWatcher:
                     except Exception:  # noqa: BLE001 - unreadable card:
                         continue  # leave for an operator to inspect
                     if card.is_expired():
+                        # Re-fetch immediately before deleting: a worker
+                        # heartbeat landing between the first read and
+                        # the delete re-stamps the card, and deleting on
+                        # the stale copy would sweep a live model. The
+                        # narrow re-check window can't fully close the
+                        # race (the store has no compare-and-delete) but
+                        # the heartbeat re-publishes every
+                        # CARD_MAX_AGE_S/3, so a lost card outlives one
+                        # period at most.
+                        raw = await self.drt.object_store.get(MDC_BUCKET, key)
+                        if raw is None:
+                            continue
+                        try:
+                            card = ModelDeploymentCard.from_json(raw.decode())
+                        except Exception:  # noqa: BLE001 - unreadable now:
+                            continue  # leave for an operator to inspect
+                        if not card.is_expired():
+                            continue  # heartbeat won the race; keep it
                         await self.drt.object_store.delete(MDC_BUCKET, key)
                         logger.info("swept expired model card %s", key)
             except asyncio.CancelledError:

@@ -17,6 +17,8 @@ from prometheus_client import (
     generate_latest,
 )
 
+from ..telemetry import get_telemetry
+
 CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
 
 
@@ -49,7 +51,10 @@ class ServiceMetrics:
         )
 
     def render(self) -> bytes:
-        return generate_latest(self.registry)
+        # Unified scrape surface: HTTP-service series plus the
+        # process-wide telemetry registry (stage histograms, engine
+        # gauges) — same pattern as components/metrics.py.
+        return generate_latest(self.registry) + get_telemetry().render()
 
     def track(self, model: str, endpoint: str, request_type: str) -> "RequestTracker":
         return RequestTracker(self, model, endpoint, request_type)

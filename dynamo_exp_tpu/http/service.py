@@ -28,6 +28,7 @@ from ..preprocessor.preprocessor import InvalidRequestError, PromptTooLongError
 from ..protocols.sse import encode_done, encode_frame
 from ..runtime.annotated import Annotated
 from ..runtime.engine import AsyncEngine, AsyncEngineContext
+from ..telemetry import span
 from .metrics import CONTENT_TYPE_LATEST, ServiceMetrics
 
 logger = logging.getLogger(__name__)
@@ -122,6 +123,7 @@ class HttpService:
         return web.json_response(listing.model_dump())
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        # ServiceMetrics.render() already merges the telemetry registry.
         return web.Response(
             body=self.metrics.render(), content_type="text/plain", charset="utf-8"
         )
@@ -178,20 +180,39 @@ class HttpService:
         ctx = _FanoutContext(ctxs)
         request_type = "stream" if req.stream else "unary"
         streaming = req.stream
-        with self.metrics.track(req.model, endpoint, request_type) as tracker:
+        # Root span of the request's trace: everything below (preprocess,
+        # routing, engine stages, KV transfer) parents onto this via the
+        # trace contextvar, and log lines emitted during handling carry
+        # its trace_id.
+        with span(
+            "http_request",
+            request_id=ctx.id,
+            model=req.model,
+            endpoint=endpoint,
+            request_type=request_type,
+        ) as root, self.metrics.track(req.model, endpoint, request_type) as tracker:
+            # Inside the trace context: this line (and everything logged
+            # below it while handling) carries the trace_id in JSONL mode.
+            logger.info(
+                "request %s: model=%s endpoint=%s type=%s",
+                ctx.id, req.model, endpoint, request_type,
+            )
             try:
                 streams = [
                     await engine.generate(p, c) for p, c in zip(sub_payloads, ctxs)
                 ]
             except PromptTooLongError as e:
                 tracker.status = "rejected"
+                root.set(status="rejected")
                 return _error_response(400, str(e), err_type="context_length_exceeded")
             except InvalidRequestError as e:
                 tracker.status = "rejected"
+                root.set(status="rejected")
                 return _error_response(400, str(e), err_type="invalid_request_error")
             except Exception as e:
                 logger.exception("engine rejected request")
                 tracker.status = "error"
+                root.set(status="error")
                 return _error_response(500, str(e))
 
             async def _typed_chunks():
@@ -215,6 +236,7 @@ class HttpService:
                 except Exception as e:
                     logger.exception("request failed")
                     tracker.status = "error"
+                    root.set(status="error")
                     ctx.kill()
                     return _error_response(500, str(e))
                 return web.json_response(full.model_dump(exclude_none=True))
@@ -235,11 +257,13 @@ class HttpService:
                 # Client went away: kill generation immediately.
                 logger.info("client disconnected; killing request %s", ctx.id)
                 tracker.status = "disconnect"
+                root.set(status="disconnect")
                 ctx.kill()
                 raise
             except Exception as e:
                 logger.exception("stream failed mid-flight")
                 tracker.status = "error"
+                root.set(status="error")
                 ctx.kill()
                 err = Annotated.from_error(str(e))
                 await resp.write(encode_frame(err).encode())

@@ -16,6 +16,7 @@ from typing import Any
 from ..runtime.component import Component
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from ..runtime.push_router import PushRouter, RouterMode
+from ..telemetry import span as trace_span
 from .indexer import KvIndexer
 from .metrics_aggregator import KvMetricsAggregator
 from .protocols import (
@@ -62,13 +63,15 @@ class KvRouter(AsyncEngine):
 
     async def schedule(self, token_ids: list[int]) -> RouterResponse:
         await self.start()
-        endpoints = self.aggregator.endpoints
-        if not endpoints.metrics:
-            endpoints = await self.aggregator.scrape_once()
-        overlaps = self.indexer.find_matches_for_request(token_ids)
-        worker_id, overlap = self.selector.select_worker(
-            endpoints, overlaps, len(token_ids), self.block_size
-        )
+        with trace_span("kv_route", isl_tokens=len(token_ids)) as sp:
+            endpoints = self.aggregator.endpoints
+            if not endpoints.metrics:
+                endpoints = await self.aggregator.scrape_once()
+            overlaps = self.indexer.find_matches_for_request(token_ids)
+            worker_id, overlap = self.selector.select_worker(
+                endpoints, overlaps, len(token_ids), self.block_size
+            )
+            sp.set(worker_id=worker_id, overlap_blocks=overlap)
         # Dead-worker hygiene: drop index entries for workers that left.
         for wid in list(overlaps.scores):
             if wid not in endpoints.metrics:

@@ -134,7 +134,9 @@ def _qualify(endpoint: str, namespace: str) -> str:
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="llmctl", description=__doc__)
-    p.add_argument("--coordinator", required=True, help="control plane host:port")
+    # Required for the control-plane planes; ``trace`` works offline
+    # from recorder files (validated in run()).
+    p.add_argument("--coordinator", default="", help="control plane host:port")
     p.add_argument("-n", "--namespace", default="dynamo")
     sub = p.add_subparsers(dest="plane", required=True)
     http = sub.add_parser("http", help="HTTP-served model registrations")
@@ -167,7 +169,53 @@ def build_parser() -> argparse.ArgumentParser:
     dset.add_argument("model_name")
     dset.add_argument("--max-local-prefill-length", type=int, required=True)
     dset.add_argument("--max-prefill-queue-size", type=int, default=2)
+
+    # Offline trace reconstruction from the telemetry recorder JSONL
+    # (``DYN_TRACE_FILE``): no argument lists recorded traces; with a
+    # trace_id (full/prefix) or request id, pretty-prints its span tree.
+    trace = sub.add_parser(
+        "trace", help="reconstruct a request's span timeline from recorder JSONL"
+    )
+    trace.add_argument(
+        "trace_id", nargs="?", default="",
+        help="trace id (full or prefix) or request id; omit to list traces",
+    )
+    trace.add_argument(
+        "--trace-file", action="append", default=None,
+        help="recorder JSONL path(s); defaults to $DYN_TRACE_FILE "
+             "(rotated generations are read automatically)",
+    )
     return p
+
+
+def run_trace(args) -> int:
+    import os
+
+    from .telemetry import find_trace, list_traces, load_spans, render_timeline
+
+    paths = args.trace_file or (
+        [os.environ["DYN_TRACE_FILE"]] if os.environ.get("DYN_TRACE_FILE") else []
+    )
+    if not paths:
+        print(
+            "no trace files: pass --trace-file or set DYN_TRACE_FILE",
+            file=sys.stderr,
+        )
+        return 2
+    spans = load_spans(paths)
+    if not spans:
+        print("no spans recorded", file=sys.stderr)
+        return 1
+    if not args.trace_id:
+        for tid, n, dur, stage in list_traces(spans):
+            print(f"{tid}  {n:3d} spans  {dur * 1e3:9.1f}ms  {stage}")
+        return 0
+    group = find_trace(spans, args.trace_id)
+    if not group:
+        print(f"no trace matching {args.trace_id!r}", file=sys.stderr)
+        return 1
+    print(render_timeline(group))
+    return 0
 
 
 async def get_disagg(drt, args) -> int:
@@ -195,6 +243,11 @@ async def run(args) -> int:
     from .runtime.component import DistributedRuntime
     from .runtime.config import RuntimeConfig
 
+    if args.plane == "trace":  # offline: reads recorder files, no cluster
+        return run_trace(args)
+    if not args.coordinator:
+        print("--coordinator is required for this command", file=sys.stderr)
+        return 2
     drt = DistributedRuntime(
         config=RuntimeConfig(coordinator_endpoint=args.coordinator)
     )

@@ -17,6 +17,7 @@ from ..protocols.delta import ChatDeltaGenerator, CompletionDeltaGenerator
 from ..protocols.openai import ChatCompletionRequest, CompletionRequest
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from ..runtime.pipeline import Operator
+from ..telemetry import span as trace_span
 from ..tokenizer import Tokenizer
 from .prompt import PromptFormatter
 
@@ -125,11 +126,13 @@ class OpenAIPreprocessor(Operator):
                 else CompletionRequest.model_validate(request)
             )
         is_chat = isinstance(request, ChatCompletionRequest)
-        backend_input = (
-            self.preprocess_chat(request)
-            if is_chat
-            else self.preprocess_completion(request)
-        )
+        with trace_span("preprocess", chat=is_chat) as sp:
+            backend_input = (
+                self.preprocess_chat(request)
+                if is_chat
+                else self.preprocess_completion(request)
+            )
+            sp.set(prompt_tokens=len(backend_input.token_ids))
         want_usage = bool(request.stream_options and request.stream_options.include_usage)
         stream = await next_engine.generate(backend_input.to_dict(), context)
         gen = (

@@ -72,6 +72,11 @@ def parse_args(argv: list[str]):
     p.add_argument("--profiler-port", type=int, default=0,
                    help="expose the jax.profiler gRPC server on this port "
                         "(attach with tensorboard/xprof); 0 = off")
+    p.add_argument("--trace-file", default="",
+                   help="record request span telemetry to exactly this JSONL "
+                        "file (reconstruct with `llmctl trace <id>`). "
+                        "DYN_TRACE_FILE does the same but records to "
+                        "<path>.pid<pid>, safe for multi-process graphs")
     # Multi-host engine (reference: MultiNodeConfig, engines.rs:41-50 +
     # ray.rs leader/follower join): every node runs this CLI with the
     # same flags plus its own --node-rank; rank 0 is the leader.
@@ -301,6 +306,35 @@ async def run_http(opts, drt, core, full, mdc):
         await svc.stop()
 
 
+def tokenizer_registrable(model_path: str) -> bool:
+    """Can an ingress build a preprocessor chain from this model dir?
+
+    Probe for actual tokenizer artifacts instead of assuming — a
+    weights-only dir registered with ingress would strand it in a
+    rebuild loop. Beyond the fast/SentencePiece artifacts, GPT-2-style
+    dirs ship ``vocab.json`` + ``merges.txt``; anything else gets one
+    real load attempt (the transformers fallback) so exotic-but-loadable
+    layouts still register.
+    """
+    if any(
+        os.path.exists(os.path.join(model_path, name))
+        for name in ("tokenizer.json", "tokenizer.model")
+    ):
+        return True
+    if all(
+        os.path.exists(os.path.join(model_path, name))
+        for name in ("vocab.json", "merges.txt")
+    ):
+        return True
+    from .tokenizer import Tokenizer
+
+    try:
+        Tokenizer.from_pretrained(model_path)
+        return True
+    except Exception:  # noqa: BLE001 - genuinely tokenizer-less
+        return False
+
+
 async def run_worker(opts, drt, core, tpu_engine, mdc=None):
     """Worker node: serve the core engine on a discoverable endpoint
     (reference: EngineConfig::StaticCore + Ingress, lib.rs:200-300)."""
@@ -360,12 +394,11 @@ async def run_worker(opts, drt, core, tpu_engine, mdc=None):
                     in GGUFFile.parse(opts.model_path).metadata
                 )
         else:
-            # Model dir: probe for an actual tokenizer artifact instead
-            # of assuming — a weights-only dir registered here would
-            # strand ingress in a rebuild loop.
-            registrable = any(
-                os.path.exists(os.path.join(opts.model_path, name))
-                for name in ("tokenizer.json", "tokenizer.model")
+            # Off the event loop: the probe's fallback may run a full
+            # tokenizer load (transformers import) and must not stall
+            # this process's coordinator read loop and heartbeats.
+            registrable = await asyncio.to_thread(
+                tokenizer_registrable, opts.model_path
             )
         if registrable:
             await register_llm(
@@ -512,6 +545,11 @@ async def main_async(opts) -> None:
 
         start_profiler_server(opts.profiler_port)
 
+    if opts.trace_file:
+        from .telemetry import get_telemetry
+
+        get_telemetry().configure(opts.trace_file)
+
     needs_cluster = opts.input.startswith("dyn://") or opts.output.startswith("dyn://")
     if needs_cluster and not opts.coordinator:
         raise SystemExit("dyn:// endpoints need --coordinator (or DYN_COORDINATOR)")
@@ -576,10 +614,11 @@ async def main_async(opts) -> None:
 
 
 def main(argv: list[str] | None = None) -> None:
-    logging.basicConfig(
-        level=os.environ.get("DYN_LOG", "INFO").upper(),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    # DYN_LOG level + DYN_LOGGING_JSONL format; JSONL lines carry the
+    # current request's trace_id (telemetry log correlation).
+    from .runtime.logging import configure_logging
+
+    configure_logging()
     opts = parse_args(argv if argv is not None else sys.argv[1:])
     loop = asyncio.new_event_loop()
     main_task = loop.create_task(main_async(opts))

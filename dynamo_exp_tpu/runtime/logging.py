@@ -13,6 +13,8 @@ import os
 import sys
 import time
 
+from ..telemetry.context import current_trace
+
 
 class JsonlFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
@@ -23,6 +25,13 @@ class JsonlFormatter(logging.Formatter):
             "target": record.name,
             "message": record.getMessage(),
         }
+        # Log↔trace correlation: any line emitted while a request's
+        # trace context is current carries its ids (the reference's
+        # tracing-subscriber span fields in JSONL logs).
+        tc = current_trace()
+        if tc is not None:
+            entry["trace_id"] = tc.trace_id
+            entry["span_id"] = tc.span_id
         if record.exc_info and record.exc_info[0] is not None:
             entry["exception"] = self.formatException(record.exc_info)
         return json.dumps(entry)

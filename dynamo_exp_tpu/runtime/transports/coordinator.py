@@ -35,6 +35,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import AsyncIterator
 
+from ...telemetry import current_trace_id
 from .base import (
     Discovery,
     EventPlane,
@@ -474,7 +475,13 @@ class CoordinatorClient:
         rid = next(self._ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        msg = TwoPartMessage(MsgType.DATA, {"op": op, "rid": rid, **(header or {})}, payload)
+        head = {"op": op, "rid": rid, **(header or {})}
+        # Control-plane ops performed on behalf of a traced request carry
+        # its trace_id so coordinator-side slow-op logs correlate.
+        tid = current_trace_id()
+        if tid is not None:
+            head.setdefault("trace_id", tid)
+        msg = TwoPartMessage(MsgType.DATA, head, payload)
 
         # Shielded, with the lock INSIDE the shield: this connection is
         # shared by every plane in the process. A caller cancelled
@@ -487,9 +494,19 @@ class CoordinatorClient:
             async with self._wlock:
                 await write_message(self._writer, msg)
 
-        await asyncio.shield(_locked_write())
-        t0 = time.monotonic()
-        h, pl = await fut
+        try:
+            await asyncio.shield(_locked_write())
+            t0 = time.monotonic()
+            h, pl = await fut
+        finally:
+            # A caller cancelled any time after registering rid (even
+            # while awaiting the shielded write — the write itself
+            # completes, but the CancelledError surfaces here first)
+            # would otherwise leave its entry in _pending forever: the
+            # reply arrives, resolves a future nobody awaits, and the
+            # dict grows per abandoned call. The read loop pops on
+            # normal resolution, so this is a no-op on the happy path.
+            self._pending.pop(rid, None)
         if (dt := time.monotonic() - t0) > 1.0:
             logger.warning("slow coordinator op %s: %.2fs", op, dt)
         if "error" in h:

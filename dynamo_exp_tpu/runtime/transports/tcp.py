@@ -30,6 +30,7 @@ import logging
 import weakref
 from typing import AsyncIterator
 
+from ...telemetry import TraceContext, attach as trace_attach, detach as trace_detach, wire_headers
 from ..engine import AsyncEngineContext
 from .base import (
     Handler,
@@ -155,6 +156,10 @@ class TcpRequestPlane(RequestPlane):
         handler, _, inflight = entry
         request = json.loads(msg.payload) if msg.payload else {}
         context = AsyncEngineContext(request_id=msg.header.get("request_id"))
+        # Cross-process trace continuation: the caller's trace context
+        # rides the request header; adopt it so every span/log emitted
+        # while handling joins the caller's trace.
+        trace_token = trace_attach(TraceContext.from_wire(msg.header.get("trace")))
         inflight[0] += 1
 
         # Control reader: stop/kill frames, and connection-drop => kill.
@@ -193,6 +198,7 @@ class TcpRequestPlane(RequestPlane):
                     TwoPartMessage(MsgType.ERROR, {"message": f"{type(e).__name__}: {e}"}),
                 )
         finally:
+            trace_detach(trace_token)
             inflight[0] -= 1
             control_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
@@ -216,13 +222,13 @@ class TcpRequestPlane(RequestPlane):
             raise ConnectionError(
                 f"connect to {instance.transport_address} failed: {e}"
             ) from e
+        header = {"instance_id": instance.instance_id, "request_id": context.id}
+        trace = wire_headers()
+        if trace:
+            header["trace"] = trace
         await write_message(
             writer,
-            TwoPartMessage(
-                MsgType.REQUEST,
-                {"instance_id": instance.instance_id, "request_id": context.id},
-                json.dumps(request).encode(),
-            ),
+            TwoPartMessage(MsgType.REQUEST, header, json.dumps(request).encode()),
         )
 
         # Forward local stop/kill upstream as CONTROL frames.

@@ -295,7 +295,11 @@ async def serve_graph(args) -> None:
 
 
 def main(argv: list[str] | None = None) -> None:
-    logging.basicConfig(level="INFO")
+    # DYN_LOG / DYN_LOGGING_JSONL aware (trace-correlated JSONL lines);
+    # service processes inherit DYN_TRACE_FILE for span recording.
+    from ..runtime.logging import configure_logging
+
+    configure_logging()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("target", help="pkg.module:RootClass")
     p.add_argument("-f", "--config", default=None, help="service config YAML")

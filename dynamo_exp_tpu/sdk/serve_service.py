@@ -117,7 +117,11 @@ async def run_service(
 
 
 def main(argv: list[str] | None = None) -> None:
-    logging.basicConfig(level="INFO")
+    # DYN_LOG / DYN_LOGGING_JSONL aware (trace-correlated JSONL lines);
+    # service processes inherit DYN_TRACE_FILE for span recording.
+    from ..runtime.logging import configure_logging
+
+    configure_logging()
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("target", help="pkg.module:RootClass")
     p.add_argument("--service-name", default=None)

@@ -1,0 +1,42 @@
+"""End-to-end request tracing + per-stage latency telemetry.
+
+One request through the stack yields a span tree — HTTP ingress →
+preprocess → KV-router decision → (queue wait → prefill | remote
+prefill → KV transfer) → decode — correlated by a contextvar-carried
+``trace_id`` that also lands in JSONL log lines and rides the wire
+across the request plane and the disagg protocol. See
+``docs/observability.md``.
+"""
+
+from .context import (
+    TraceContext,
+    attach,
+    current_span_id,
+    current_trace,
+    current_trace_id,
+    detach,
+    new_trace,
+    wire_headers,
+)
+from .spans import Span, Telemetry, adopt, get_telemetry, span
+from .timeline import find_trace, list_traces, load_spans, render_timeline
+
+__all__ = [
+    "TraceContext",
+    "Span",
+    "Telemetry",
+    "adopt",
+    "attach",
+    "current_span_id",
+    "current_trace",
+    "current_trace_id",
+    "detach",
+    "find_trace",
+    "get_telemetry",
+    "list_traces",
+    "load_spans",
+    "new_trace",
+    "render_timeline",
+    "span",
+    "wire_headers",
+]

@@ -1,0 +1,323 @@
+"""Span emission: one Telemetry hub per process.
+
+Every finished span goes two places at once:
+
+- the JSONL :class:`~dynamo_exp_tpu.recorder.Recorder` (when configured
+  via ``configure(trace_file=...)`` or ``DYN_TRACE_FILE``) for offline
+  timeline reconstruction (``llmctl trace <id>``);
+- Prometheus histograms per stage in ``Telemetry.registry`` — merged
+  into the existing ``/metrics`` endpoints by the HTTP service and the
+  standalone metrics exporter.
+
+The hub also owns the engine-level gauges (HBM page occupancy, offload
+hit rate, scheduler depth, decode batch utilization) that the engine
+loop publishes; gauge writes and span emission are thread-safe, so the
+engine loop thread can emit directly with an explicit
+:class:`~dynamo_exp_tpu.telemetry.context.TraceContext` instead of the
+contextvar it doesn't share.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram
+
+from .context import TraceContext, attach, current_trace, detach, new_trace
+
+logger = logging.getLogger(__name__)
+
+# Stage-duration buckets: KV-router decisions are sub-millisecond while
+# a long decode runs tens of seconds — the defaults' 10s cap is too low.
+_STAGE_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+_TBT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+_BYTES_BUCKETS = (
+    1 << 10, 16 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20,
+    64 << 20, 256 << 20, 1 << 30,
+)
+
+# Engine gauges: metrics()-dict key -> (prometheus name, help).
+_ENGINE_GAUGES = (
+    ("hbm_page_occupancy", "Fraction of device KV pages in use"),
+    ("offload_hit_rate", "G2 host-tier hit rate (hits / (hits+misses))"),
+    ("num_requests_running", "Sequences actively decoding"),
+    ("num_requests_waiting", "Sequences waiting for admission"),
+    ("decode_batch_utilization", "ACTIVE decode slots / total slots"),
+)
+
+
+@dataclass
+class Span:
+    """One finished stage of a request."""
+
+    stage: str
+    trace_id: str
+    span_id: str
+    parent_span_id: str = ""
+    start: float = 0.0  # unix seconds
+    end: float = 0.0
+    attrs: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        return max(self.end - self.start, 0.0)
+
+    def to_event(self) -> dict:
+        return {
+            "type": "span",
+            "stage": self.stage,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "start": self.start,
+            "end": self.end,
+            "attrs": self.attrs,
+        }
+
+    @classmethod
+    def from_event(cls, d: dict) -> "Span":
+        return cls(
+            stage=d.get("stage", "?"),
+            trace_id=d.get("trace_id", ""),
+            span_id=d.get("span_id", ""),
+            parent_span_id=d.get("parent_span_id", ""),
+            start=float(d.get("start", 0.0)),
+            end=float(d.get("end", 0.0)),
+            attrs=d.get("attrs", {}) or {},
+        )
+
+
+class Telemetry:
+    """Per-process span sink + unified Prometheus registry."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self._recorder = None
+        self._rec_lock = threading.Lock()
+        self.stage_duration = Histogram(
+            "dynamo_stage_duration_seconds",
+            "Per-stage request latency (one series per pipeline stage)",
+            ["stage"],
+            buckets=_STAGE_BUCKETS,
+            registry=self.registry,
+        )
+        self.queue_wait = Histogram(
+            "dynamo_engine_queue_wait_seconds",
+            "Submission-to-admission wait in the engine scheduler",
+            buckets=_STAGE_BUCKETS,
+            registry=self.registry,
+        )
+        self.prefill_compute = Histogram(
+            "dynamo_engine_prefill_seconds",
+            "Admission-to-first-token prefill latency",
+            buckets=_STAGE_BUCKETS,
+            registry=self.registry,
+        )
+        self.time_between_tokens = Histogram(
+            "dynamo_engine_time_between_tokens_seconds",
+            "Decode inter-token latency (per token, window-averaged)",
+            buckets=_TBT_BUCKETS,
+            registry=self.registry,
+        )
+        self.kv_transfer_duration = Histogram(
+            "dynamo_kv_transfer_duration_seconds",
+            "Disagg KV page transfer wall time",
+            ["direction"],  # send | recv
+            buckets=_STAGE_BUCKETS,
+            registry=self.registry,
+        )
+        self.kv_transfer_bytes = Histogram(
+            "dynamo_kv_transfer_bytes",
+            "Disagg KV page transfer payload size",
+            ["direction"],
+            buckets=_BYTES_BUCKETS,
+            registry=self.registry,
+        )
+        self.kv_transfer_total = Counter(
+            "dynamo_kv_transfers_total",
+            "Disagg KV transfers by direction and outcome",
+            ["direction", "outcome"],
+            registry=self.registry,
+        )
+        self.engine_gauges = {
+            key: Gauge(f"dynamo_engine_{key}", help_, registry=self.registry)
+            for key, help_ in _ENGINE_GAUGES
+        }
+
+    # ------------------------------------------------------------ recorder
+    def configure(self, trace_file: str | None) -> None:
+        """Point span recording at a JSONL file (None disables)."""
+        from ..recorder import Recorder
+
+        with self._rec_lock:
+            if self._recorder is not None:
+                self._recorder.close()
+                self._recorder = None
+            if trace_file:
+                self._recorder = Recorder(trace_file)
+
+    def configure_from_env(self) -> None:
+        """Honor ``DYN_TRACE_FILE`` if set.
+
+        The env var is shared by every process of a supervised graph,
+        but the Recorder's size rotation assumes a single writer — two
+        processes rotating one shared file clobber each other's
+        generations. So each process records to ``<path>.pid<pid>`` (a
+        suffix disjoint from the rotation's bare ``.N`` namespace, so a
+        pid-1 container process can't be renamed over by another
+        writer's rotation); ``load_spans(<path>)`` and ``llmctl trace``
+        pick the siblings up automatically."""
+        path = os.environ.get("DYN_TRACE_FILE", "")
+        if path:
+            self.configure(f"{path}.pid{os.getpid()}")
+
+    @property
+    def trace_file(self) -> str | None:
+        rec = self._recorder
+        return rec.path if rec is not None else None
+
+    # ------------------------------------------------------------ emission
+    def emit(self, span: Span) -> None:
+        """Record one finished span (thread-safe; never raises into the
+        serving path)."""
+        self.stage_duration.labels(span.stage).observe(span.duration_s)
+        rec = self._recorder
+        if rec is not None:
+            try:
+                with self._rec_lock:
+                    rec.record(span.to_event(), ts=span.end)
+            except Exception:  # noqa: BLE001 - tracing must not break serving
+                logger.exception("span recording failed")
+
+    def emit_stage(
+        self,
+        stage: str,
+        start: float,
+        end: float,
+        trace: TraceContext | None,
+        **attrs: Any,
+    ) -> None:
+        """Explicit-time emission for call sites that can't hold a
+        ``with span(...)`` open — the engine loop thread stamps
+        monotonic-derived unix times and hands them here."""
+        if trace is None:
+            return
+        child = trace.child()
+        self.emit(
+            Span(
+                stage=stage,
+                trace_id=child.trace_id,
+                span_id=child.span_id,
+                parent_span_id=trace.span_id,
+                start=start,
+                end=end,
+                attrs={k: v for k, v in attrs.items() if v is not None},
+            )
+        )
+
+    # -------------------------------------------------------------- gauges
+    def publish_engine_gauges(self, metrics: dict) -> None:
+        """Mirror an engine ``metrics()`` dict into the engine gauges
+        (unknown keys ignored, so callers can pass the full dict)."""
+        for key in self.engine_gauges:
+            if key in metrics:
+                self.engine_gauges[key].set(float(metrics[key]))
+
+    def render(self) -> bytes:
+        from prometheus_client import generate_latest
+
+        return generate_latest(self.registry)
+
+
+class _ActiveSpan:
+    """Context manager for in-task spans: opens a child of the current
+    contextvar trace (or a fresh root), makes itself current inside the
+    block, and emits on exit. ``attrs`` may be amended via ``set``."""
+
+    def __init__(self, hub: "Telemetry", stage: str, attrs: dict):
+        self._hub = hub
+        self.stage = stage
+        self.attrs = attrs
+        self._token = None
+        self._t0 = 0.0
+        self.context: TraceContext | None = None
+
+    def set(self, **attrs: Any) -> None:
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "_ActiveSpan":
+        parent = current_trace()
+        if parent is None:  # no inbound trace: this span roots a new one
+            self.context = new_trace()
+            self._parent_id = ""
+        else:
+            self.context = parent.child()
+            self._parent_id = parent.span_id
+        self._token = attach(self.context)
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            detach(self._token)
+        if exc_type is not None:
+            self.attrs.setdefault("error", f"{exc_type.__name__}: {exc}")
+        self._hub.emit(
+            Span(
+                stage=self.stage,
+                trace_id=self.context.trace_id,
+                span_id=self.context.span_id,
+                parent_span_id=self._parent_id,
+                start=self._t0,
+                end=time.time(),
+                attrs={k: v for k, v in self.attrs.items() if v is not None},
+            )
+        )
+
+
+# ---------------------------------------------------------------- process hub
+_global: Telemetry | None = None
+_global_lock = threading.Lock()
+
+
+def get_telemetry() -> Telemetry:
+    """The process-wide hub (created lazily; picks up DYN_TRACE_FILE)."""
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                hub = Telemetry()
+                hub.configure_from_env()
+                _global = hub
+    return _global
+
+
+def span(stage: str, **attrs: Any) -> _ActiveSpan:
+    """``with span("preprocess", tokens=n):`` — child of the current
+    trace, or the root of a fresh one on an untraced path."""
+    return _ActiveSpan(get_telemetry(), stage, dict(attrs))
+
+
+@contextlib.contextmanager
+def adopt(tc: TraceContext | None):
+    """Make a deserialized wire context current for the enclosed block
+    (no span is emitted — use for transport ingress points)."""
+    if tc is None:
+        yield
+        return
+    token = attach(tc)
+    try:
+        yield
+    finally:
+        detach(token)

@@ -1,0 +1,150 @@
+"""Offline trace reconstruction from recorder JSONL (``llmctl trace``).
+
+Spans land in the recorder as ``{"ts": ..., "event": {"type": "span",
+...}}`` lines, possibly interleaved across stages, processes, and file
+rotations. This module loads them back, groups by ``trace_id``, rebuilds
+the parent/child tree, and renders an ASCII timeline.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+from ..recorder import Recorder
+from .spans import Span
+
+
+def load_spans(paths: list[str]) -> list[Span]:
+    """Read every span event from the given JSONL files.
+
+    For each path, recorder siblings are read too: rotated generations
+    (``path.N``), per-process recordings from a shared
+    ``DYN_TRACE_FILE`` (``path.pid<pid>``), and their rotations
+    (``path.pid<pid>.N``). Unrelated siblings (``path.1.bak``,
+    ``path.1.gz``) are skipped, not crashed on. Ordering across files
+    doesn't matter — spans carry absolute timestamps.
+    """
+    gen_re = re.compile(r"^(\.pid\d+)?(\.\d+)*$")
+
+    def _is_generation(cand: str, base: str) -> bool:
+        suffix = cand[len(base) :]
+        return bool(suffix) and gen_re.fullmatch(suffix) is not None
+
+    spans: list[Span] = []
+    seen: set[str] = set()
+    expanded: list[str] = []
+    for p in paths:
+        siblings = sorted(
+            c
+            for c in glob.glob(p + ".*")
+            if _is_generation(c, p)
+        )
+        for cand in siblings + [p]:
+            if cand not in seen and os.path.exists(cand):
+                seen.add(cand)
+                expanded.append(cand)
+    for path in expanded:
+        for _ts, event in Recorder.replay(path):
+            if isinstance(event, dict) and event.get("type") == "span":
+                spans.append(Span.from_event(event))
+    return spans
+
+
+def find_trace(spans: list[Span], needle: str) -> list[Span]:
+    """Spans of the trace identified by ``needle``: a full or prefix
+    trace_id, or a request id recorded in any span's attrs."""
+    by_trace: dict[str, list[Span]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    if needle in by_trace:
+        return by_trace[needle]
+    for tid, group in by_trace.items():
+        if tid.startswith(needle):
+            return group
+    for tid, group in by_trace.items():
+        if any(s.attrs.get("request_id") == needle for s in group):
+            return group
+    return []
+
+
+def _order_tree(spans: list[Span]) -> list[tuple[Span, int]]:
+    """(span, depth) in tree order: children under parents, siblings by
+    start time. Orphans (parent span missing, e.g. a lost process's
+    file) surface at the root level instead of disappearing."""
+    by_id = {s.span_id: s for s in spans}
+    children: dict[str, list[Span]] = {}
+    roots: list[Span] = []
+    for s in spans:
+        if s.parent_span_id and s.parent_span_id in by_id:
+            children.setdefault(s.parent_span_id, []).append(s)
+        else:
+            roots.append(s)
+    out: list[tuple[Span, int]] = []
+
+    def walk(span: Span, depth: int) -> None:
+        out.append((span, depth))
+        for c in sorted(children.get(span.span_id, []), key=lambda x: x.start):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda x: x.start):
+        walk(r, 0)
+    return out
+
+
+def render_timeline(spans: list[Span], width: int = 40) -> str:
+    """Human-readable span tree with offset/duration bars::
+
+        trace 4f1f2a… — 6 spans, 132.8ms total
+        http_request          0.0ms  132.8ms |##############################|
+          preprocess          0.3ms    1.9ms |=                             |
+          ...
+    """
+    if not spans:
+        return "no spans"
+    t0 = min(s.start for s in spans)
+    t1 = max(s.end for s in spans)
+    total = max(t1 - t0, 1e-9)
+    ordered = _order_tree(spans)
+    name_w = max(len("  " * d + s.stage) for s, d in ordered)
+    req = next(
+        (s.attrs["request_id"] for s, _ in ordered if "request_id" in s.attrs),
+        None,
+    )
+    head = f"trace {spans[0].trace_id} — {len(spans)} spans, {total * 1e3:.1f}ms"
+    if req:
+        head += f" (request {req})"
+    lines = [head]
+    for s, depth in ordered:
+        off = s.start - t0
+        left = int(round((off / total) * width))
+        fill = max(int(round((s.duration_s / total) * width)), 1)
+        fill = min(fill, width - min(left, width - 1))
+        bar = " " * min(left, width - 1) + "#" * fill
+        bar = bar[:width].ljust(width)
+        label = ("  " * depth + s.stage).ljust(name_w)
+        lines.append(
+            f"{label}  {off * 1e3:8.1f}ms {s.duration_s * 1e3:9.1f}ms |{bar}|"
+        )
+        extra = {k: v for k, v in s.attrs.items() if k != "request_id"}
+        if extra:
+            kv = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+            lines.append(" " * (name_w + 2) + f"  {kv}")
+    return "\n".join(lines)
+
+
+def list_traces(spans: list[Span]) -> list[tuple[str, int, float, str]]:
+    """(trace_id, span count, duration_s, root stage) per trace, by
+    start time — the ``llmctl trace`` no-argument listing."""
+    by_trace: dict[str, list[Span]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    rows = []
+    for tid, group in by_trace.items():
+        t0 = min(s.start for s in group)
+        t1 = max(s.end for s in group)
+        root = min(group, key=lambda s: s.start)
+        rows.append((tid, len(group), t1 - t0, root.stage, t0))
+    rows.sort(key=lambda r: r[-1])
+    return [(tid, n, dur, stage) for tid, n, dur, stage, _t0 in rows]

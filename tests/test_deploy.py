@@ -252,6 +252,89 @@ async def test_operator_reconciles_applies_and_finalizes(tmp_path, artifact):
         await store.close()
 
 
+async def test_kubectl_backend_second_reconcile_applies_nothing(tmp_path):
+    """Drift detection at the KubectlBackend level: the content-hash
+    annotation must hash the doc AS RENDERED (before _decorate adds
+    ownership labels), or every reconcile pass sees a mismatch and
+    re-applies the whole graph forever."""
+    from dynamo_exp_tpu.deploy.operator import (
+        DeploymentOperator,
+        KubectlBackend,
+    )
+
+    class FakeKubectlBackend(KubectlBackend):
+        """kubectl simulated in memory: apply/get/delete semantics, same
+        label/annotation round-trip a real apiserver performs."""
+
+        def __init__(self):
+            super().__init__()
+            self.cluster: dict[tuple[str, str], dict] = {}
+            self.apply_count = 0
+
+        async def _run(self, *args, stdin=None):
+            if args[0] == "apply":
+                doc = yaml.safe_load(stdin)
+                self.cluster[(doc["kind"], doc["metadata"]["name"])] = doc
+                self.apply_count += 1
+                return ""
+            if args[0] == "get" and "-l" in args:
+                kind = args[1]
+                items = [
+                    d for (k, _), d in self.cluster.items()
+                    if k.lower() == kind
+                ]
+                return json.dumps({"items": items})
+            if args[0] == "get":
+                kind, name = args[1], args[2]
+                doc = self.cluster[(kind.capitalize(), name)]
+                avail = doc.get("spec", {}).get("replicas", 1)
+                return json.dumps(
+                    {**doc, "status": {"availableReplicas": avail}}
+                )
+            if args[0] == "delete":
+                self.cluster.pop((args[1].capitalize(), args[2]), None)
+                return ""
+            raise AssertionError(f"unexpected kubectl args: {args}")
+
+    docs = [
+        {
+            "kind": "Deployment",
+            "apiVersion": "apps/v1",
+            "metadata": {
+                "name": "d1-app",
+                "labels": {"app.kubernetes.io/name": "d1-app"},
+            },
+            "spec": {"replicas": 1},
+        },
+        {
+            "kind": "Service",
+            "apiVersion": "v1",
+            "metadata": {"name": "d1-app"},
+            "spec": {"ports": [{"port": 80}]},
+        },
+    ]
+    ddir = tmp_path / "store" / "deployments"
+    os.makedirs(ddir)
+    with open(ddir / "d1.json", "w") as f:
+        json.dump({"name": "d1", "manifests_yaml": yaml.safe_dump_all(docs)}, f)
+
+    backend = FakeKubectlBackend()
+    op = DeploymentOperator(str(tmp_path / "store"), backend, interval_s=0.05)
+    results = await op.reconcile_all()
+    assert results["d1"].phase == "Ready"
+    assert results["d1"].applied == 2
+    assert backend.apply_count == 2
+    # Owned resources carry the labels + content-hash annotation.
+    dep = backend.cluster[("Deployment", "d1-app")]
+    assert dep["metadata"]["labels"]["dynamo-exp-tpu/deployment"] == "d1"
+    assert backend.HASH_ANNOTATION in dep["metadata"]["annotations"]
+
+    # Steady state: the second pass must apply 0 resources.
+    results = await op.reconcile_all()
+    assert results["d1"].applied == 0 and results["d1"].deleted == 0
+    assert backend.apply_count == 2
+
+
 def test_helm_chart_assets_parse():
     """Chart.yaml/values.yaml are valid YAML and templates reference
     only values that exist (cheap lint — helm itself isn't in CI)."""

@@ -61,6 +61,31 @@ async def test_config_watcher_live_update():
         await w.close()
 
 
+def test_remote_prefill_request_tolerates_version_skew():
+    """Wire compat both ways: old payloads (no trace fields) decode, and
+    unknown future fields are ignored instead of raising TypeError."""
+    import json
+
+    old = json.dumps(
+        {"request_id": "r", "token_ids": [1, 2], "return_addr": "h:1"}
+    ).encode()
+    req = RemotePrefillRequest.from_bytes(old)
+    assert req.trace_id == "" and req.parent_span_id == ""
+
+    future = json.dumps(
+        {
+            "request_id": "r",
+            "token_ids": [1],
+            "return_addr": "h:1",
+            "trace_id": "t",
+            "parent_span_id": "p",
+            "some_future_field": 42,
+        }
+    ).encode()
+    req = RemotePrefillRequest.from_bytes(future)
+    assert req.trace_id == "t"
+
+
 # ------------------------------------------------------------------ transfer
 def test_page_codec_roundtrip_bfloat16():
     import jax.numpy as jnp

@@ -36,6 +36,26 @@ def test_parse_args_io_and_flags():
     assert (d.input, d.output) == ("text", "echo_full")
 
 
+def test_tokenizer_registrable_probe(tmp_path):
+    """Worker registration probe: fast/SP artifacts register, GPT-2-style
+    vocab.json+merges.txt dirs register, weights-only dirs don't."""
+    from dynamo_exp_tpu.run import tokenizer_registrable
+
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    (bare / "model.safetensors").write_bytes(b"")
+    assert not tokenizer_registrable(str(bare))
+
+    fast = build_tiny_model_dir(str(tmp_path / "fast"))
+    assert tokenizer_registrable(fast)
+
+    gpt2 = tmp_path / "gpt2"
+    gpt2.mkdir()
+    (gpt2 / "vocab.json").write_text("{}")
+    (gpt2 / "merges.txt").write_text("#version: 0.2\n")
+    assert tokenizer_registrable(str(gpt2))
+
+
 async def test_batch_driver_on_tpu_engine(tmp_path, capsys):
     model_dir = build_tiny_model_dir(str(tmp_path / "model"))
     prompts = tmp_path / "p.jsonl"
