@@ -1,0 +1,30 @@
+# Convenience targets mirroring the CI tiers (.github/workflows/).
+# CPU-only: everything runs on the virtual 8-device CPU mesh.
+
+PYTEST := env JAX_PLATFORMS=cpu python -m pytest
+# Three fixed seeds for the deterministic fault-injection suite; each
+# run must inject the same faults at the same points (the suite itself
+# asserts cross-run determinism per seed).
+CHAOS_SEED_SETS := 7,21,1337 11,23,4242 1,2,3
+
+.PHONY: test pre-merge nightly chaos lint
+
+test:
+	$(PYTEST) tests/ -q -m "not tpu and not weekly"
+
+pre-merge:
+	$(PYTEST) tests/ -q -m pre_merge
+
+nightly:
+	$(PYTEST) tests/ -q -m "not tpu and not weekly"
+
+# Fault-injection suite under three fixed seed sets (satellite of the
+# fault-tolerance PR; see docs/fault_tolerance.md).
+chaos:
+	@set -e; for seeds in $(CHAOS_SEED_SETS); do \
+		echo "=== chaos suite, CHAOS_SEEDS=$$seeds ==="; \
+		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_fault_tolerance.py -q -m chaos; \
+	done
+
+lint:
+	ruff check dynamo_exp_tpu/ tests/ bench.py __graft_entry__.py

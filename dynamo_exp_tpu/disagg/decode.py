@@ -16,6 +16,7 @@ from ..engine.engine import TPUEngine
 from ..engine.scheduler import RemoteKv
 from ..protocols.common import BackendInput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
+from ..runtime.health import CircuitBreaker
 from ..runtime.transports.base import WorkQueue
 from ..telemetry import span as trace_span
 from .config import DisaggConfigWatcher
@@ -27,7 +28,13 @@ logger = logging.getLogger(__name__)
 
 class DisaggDecodeEngine(AsyncEngine):
     """Wraps a TPUEngine; long uncached prefills are offloaded to the
-    prefill fleet through the work queue + KV transfer plane."""
+    prefill fleet through the work queue + KV transfer plane.
+
+    A circuit breaker guards the remote path: when the prefill fleet is
+    dead, every offload attempt burns ``transfer_timeout_s`` of TTFT
+    before falling back — after ``breaker``'s threshold of consecutive
+    failures new requests prefill locally immediately, with a half-open
+    probe re-testing the fleet each cooldown."""
 
     def __init__(
         self,
@@ -36,26 +43,37 @@ class DisaggDecodeEngine(AsyncEngine):
         receiver: KvPageReceiver,
         config: DisaggConfigWatcher,
         transfer_timeout_s: float = 60.0,
+        breaker: CircuitBreaker | None = None,
     ):
         self.engine = engine
         self.queue = queue
         self.receiver = receiver
         self.config = config
         self.transfer_timeout_s = transfer_timeout_s
+        self.breaker = breaker or CircuitBreaker(name="remote-prefill")
         self.remote_prefills = 0  # metrics
         self.local_fallbacks = 0
+        self.queue_probe_failures = 0
 
     async def generate(
         self, request: dict | BackendInput, context: AsyncEngineContext | None = None
     ) -> ResponseStream[dict]:
         ctx = context or AsyncEngineContext()
+        ctx.check_deadline("decode")
         binput = (
             request
             if isinstance(request, BackendInput)
             else BackendInput.model_validate(request)
         )
         remote_kv = None
-        if await self._should_prefill_remote(binput):
+        # Breaker state first (would_allow doesn't claim the half-open
+        # probe slot): with the fleet dead, requests must go local
+        # without even paying the queue.size() round-trip.
+        if (
+            self.breaker.would_allow()
+            and await self._should_prefill_remote(binput)
+            and self.breaker.allow()
+        ):
             remote_kv = await self._remote_prefill(binput, ctx)
         return await self.engine.generate(binput, ctx, remote_kv=remote_kv)
 
@@ -68,7 +86,18 @@ class DisaggDecodeEngine(AsyncEngine):
         prefill_len = max(len(binput.token_ids) - cached, 0)
         if prefill_len <= cfg.max_local_prefill_length:
             return False
-        queue_size = await self.queue.size()
+        try:
+            queue_size = await self.queue.size()
+        except Exception:  # noqa: BLE001 - a broken queue means "no fleet":
+            # prefill locally, per the module's best-effort contract. The
+            # request must not die because an optimization's control
+            # plane is down.
+            logger.warning(
+                "prefill queue size probe failed; prefilling locally",
+                exc_info=True,
+            )
+            self.queue_probe_failures += 1
+            return False
         return cfg.prefill_remote(prefill_len, queue_size)
 
     async def _remote_prefill(
@@ -79,6 +108,11 @@ class DisaggDecodeEngine(AsyncEngine):
 
         rid = ctx.id
         fut = self.receiver.expect(rid)
+        # The transfer wait never outlives the request's own deadline.
+        timeout = self.transfer_timeout_s
+        remaining = ctx.time_remaining()
+        if remaining is not None:
+            timeout = min(timeout, max(remaining, 0.0))
         with trace_span(
             "remote_prefill", request_id=rid, prompt_tokens=len(binput.token_ids)
         ) as sp:
@@ -96,14 +130,16 @@ class DisaggDecodeEngine(AsyncEngine):
                 model=kv_signature(self.engine.cfg),
                 trace_id=sp.context.trace_id,
                 parent_span_id=sp.context.span_id,
+                deadline_unix=ctx.deadline or 0.0,
             )
             try:
                 await self.queue.push(req.to_bytes())
                 first_token, pages = await asyncio.wait_for(
-                    fut, timeout=self.transfer_timeout_s
+                    fut, timeout=timeout
                 )
                 self._check_page_shapes(pages, len(binput.token_ids))
                 self.remote_prefills += 1
+                self.breaker.record_success()
                 sp.set(outcome="remote")
                 return RemoteKv(first_token=first_token, pages=pages)
             except Exception:  # noqa: BLE001 - remote prefill is best-effort
@@ -112,6 +148,13 @@ class DisaggDecodeEngine(AsyncEngine):
                 )
                 self.receiver.forget(rid)
                 self.local_fallbacks += 1
+                # A wait cut short by the *request's own deadline* says
+                # nothing about fleet health — only count fleet-attributable
+                # failures toward the breaker, or three short-deadline
+                # requests would lock healthy remote prefill out for a
+                # whole cooldown.
+                if not ctx.deadline_expired:
+                    self.breaker.record_failure()
                 sp.set(outcome="local_fallback")
                 return None
 
@@ -138,4 +181,6 @@ class DisaggDecodeEngine(AsyncEngine):
         m = self.engine.metrics()
         m["disagg_remote_prefills"] = self.remote_prefills
         m["disagg_local_fallbacks"] = self.local_fallbacks
+        m["disagg_queue_probe_failures"] = self.queue_probe_failures
+        m["disagg_breaker_state"] = self.breaker.state.value
         return m

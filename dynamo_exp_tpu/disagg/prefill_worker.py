@@ -10,12 +10,13 @@ finishes the request it already pulled, then stops pulling
 from __future__ import annotations
 
 import logging
+import time
 
 from ..engine.engine import TPUEngine
 from ..protocols.common import BackendInput, SamplingOptions
 from ..runtime.runtime import CancellationToken
 from ..runtime.transports.base import WorkQueue
-from ..telemetry import TraceContext, adopt
+from ..telemetry import TraceContext, adopt, get_telemetry
 from .protocol import RemotePrefillRequest, kv_signature
 from .transfer import send_kv_pages
 
@@ -38,6 +39,7 @@ class PrefillWorker:
         self.component = component
         self.served = 0  # requests completed (metrics)
         self.failed = 0
+        self.expired = 0  # dropped at pull: deadline already passed
         self._presence = None
 
     async def register(self) -> None:
@@ -50,7 +52,13 @@ class PrefillWorker:
             return
 
         async def handler(request: dict, context=None):
-            yield {"data": {"served": self.served, "failed": self.failed}}
+            yield {
+                "data": {
+                    "served": self.served,
+                    "failed": self.failed,
+                    "expired": self.expired,
+                }
+            }
 
         self._presence = await self.component.endpoint("pull").serve_endpoint(
             handler, stats_handler=lambda: self.engine.metrics()
@@ -78,6 +86,17 @@ class PrefillWorker:
         except (ValueError, TypeError, KeyError):
             logger.exception("malformed prefill request dropped")
             self.failed += 1
+            return
+        if req.deadline_unix and time.time() >= req.deadline_unix:
+            # The decode side has already given up (its transfer wait is
+            # bounded by the same deadline): drop before prefill compute
+            # and KV transfer — expired work must not occupy the fleet.
+            self.expired += 1
+            get_telemetry().deadline_exceeded.labels("prefill_queue").inc()
+            logger.info(
+                "dropping expired prefill request %s (deadline passed %.2fs ago)",
+                req.request_id, time.time() - req.deadline_unix,
+            )
             return
         if req.page_size and req.page_size != self.engine.cfg.page_size:
             await self._fail(req, "page_size mismatch")

@@ -49,6 +49,13 @@ class RemotePrefillRequest:
     # rejects unknown fields.
     trace_id: str = ""
     parent_span_id: str = ""
+    # End-to-end deadline (unix seconds, 0 = none). The prefill worker
+    # drops expired items at pull time — no prefill compute, no KV
+    # transfer for a request whose caller has already given up. Absolute
+    # time (not remaining budget) because queue residency is exactly the
+    # latency this bound must cover; decode and prefill hosts share a
+    # clock discipline (same pod).
+    deadline_unix: float = 0.0
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()

@@ -27,9 +27,14 @@ from ..protocols.openai import (
 from ..preprocessor.preprocessor import InvalidRequestError, PromptTooLongError
 from ..protocols.sse import encode_done, encode_frame
 from ..runtime.annotated import Annotated
-from ..runtime.engine import AsyncEngine, AsyncEngineContext
+from ..runtime.engine import AsyncEngine, AsyncEngineContext, DeadlineExceededError
+from ..runtime.push_router import NoInstancesError
 from ..telemetry import span
 from .metrics import CONTENT_TYPE_LATEST, ServiceMetrics
+
+# Clients hint how soon to retry a 503 (no instances / breaker open):
+# instance churn resolves within a lease TTL or breaker cooldown.
+RETRY_AFTER_S = "1"
 
 logger = logging.getLogger(__name__)
 
@@ -163,6 +168,10 @@ class HttpService:
             payload = await request.json()
             if self.request_template is not None:
                 payload = self.request_template.apply(payload)
+            # End-to-end deadline: an explicit per-request budget via the
+            # ``timeout_s`` body field or ``X-Request-Timeout-S`` header.
+            # Popped before parsing so strict models don't reject it.
+            timeout_s = _request_timeout_s(payload, request)
             req = parse(payload)
         except Exception as e:
             return _error_response(400, f"invalid request: {e}")
@@ -177,6 +186,9 @@ class HttpService:
         # One context per sub-request: a finished sub-stream must not stop
         # its batch siblings; disconnect kills them all.
         ctxs = [AsyncEngineContext() for _ in sub_payloads]
+        if timeout_s is not None:
+            for c in ctxs:
+                c.start_timeout(timeout_s)
         ctx = _FanoutContext(ctxs)
         request_type = "stream" if req.stream else "unary"
         streaming = req.stream
@@ -209,6 +221,23 @@ class HttpService:
                 tracker.status = "rejected"
                 root.set(status="rejected")
                 return _error_response(400, str(e), err_type="invalid_request_error")
+            except NoInstancesError as e:
+                # No live/healthy workers (includes breaker-open). The
+                # condition is transient — tell clients when to retry.
+                tracker.status = "unavailable"
+                root.set(status="unavailable")
+                return _error_response(
+                    503,
+                    str(e) or "no instances available",
+                    err_type="service_unavailable",
+                    headers={"Retry-After": RETRY_AFTER_S},
+                )
+            except DeadlineExceededError as e:
+                tracker.status = "deadline"
+                root.set(status="deadline")
+                return _error_response(
+                    504, str(e), err_type="deadline_exceeded"
+                )
             except Exception as e:
                 logger.exception("engine rejected request")
                 tracker.status = "error"
@@ -233,6 +262,21 @@ class HttpService:
             if not req.stream:
                 try:
                     full = await aggregate(_typed_chunks())
+                except NoInstancesError as e:
+                    tracker.status = "unavailable"
+                    root.set(status="unavailable")
+                    ctx.kill()
+                    return _error_response(
+                        503,
+                        str(e) or "no instances available",
+                        err_type="service_unavailable",
+                        headers={"Retry-After": RETRY_AFTER_S},
+                    )
+                except DeadlineExceededError as e:
+                    tracker.status = "deadline"
+                    root.set(status="deadline")
+                    ctx.kill()
+                    return _error_response(504, str(e), err_type="deadline_exceeded")
                 except Exception as e:
                     logger.exception("request failed")
                     tracker.status = "error"
@@ -299,12 +343,35 @@ def _expand_completion_batch(payload: dict) -> list[dict]:
     return [payload]
 
 
+def _request_timeout_s(payload: Any, request: web.Request) -> float | None:
+    """Per-request deadline budget: body ``timeout_s`` wins over the
+    ``X-Request-Timeout-S`` header; absent/invalid means no deadline."""
+    raw = None
+    if isinstance(payload, dict):
+        raw = payload.pop("timeout_s", None)
+    if raw is None:
+        raw = request.headers.get("X-Request-Timeout-S")
+    if raw is None:
+        return None
+    try:
+        timeout_s = float(raw)
+    except (TypeError, ValueError):
+        raise ValueError(f"timeout_s must be a number, got {raw!r}") from None
+    if timeout_s <= 0:
+        raise ValueError("timeout_s must be positive")
+    return timeout_s
+
+
 def _error_response(
-    status: int, message: str, err_type: str = "invalid_request_error"
+    status: int,
+    message: str,
+    err_type: str = "invalid_request_error",
+    headers: dict[str, str] | None = None,
 ) -> web.Response:
     return web.json_response(
         {"error": {"message": message, "type": err_type, "code": status}},
         status=status,
+        headers=headers,
     )
 
 

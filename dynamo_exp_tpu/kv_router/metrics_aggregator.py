@@ -29,7 +29,10 @@ class KvMetricsAggregator:
         self._task: asyncio.Task | None = None
 
     async def scrape_once(self) -> ProcessedEndpoints:
-        stats = await self.component.scrape_stats()
+        # Draining workers are excluded at the snapshot source: the
+        # selector never sees them, so no selection path (embedded or
+        # standalone router) can schedule onto a draining instance.
+        stats = await self.component.scrape_stats(include_draining=False)
         metrics = {
             wid: ForwardPassMetrics.from_dict(d or {}) for wid, d in stats.items()
         }

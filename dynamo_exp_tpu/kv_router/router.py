@@ -15,7 +15,7 @@ from typing import Any
 
 from ..runtime.component import Component
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
-from ..runtime.push_router import PushRouter, RouterMode
+from ..runtime.push_router import NoHealthyInstancesError, PushRouter, RouterMode
 from ..telemetry import span as trace_span
 from .indexer import KvIndexer
 from .metrics_aggregator import KvMetricsAggregator
@@ -26,7 +26,12 @@ from .protocols import (
     RouterResponse,
     kv_events_subject,
 )
-from .scheduler import DefaultWorkerSelector, WorkerSelector
+from .scheduler import (
+    DefaultWorkerSelector,
+    NoWorkersError,
+    ProcessedEndpoints,
+    WorkerSelector,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -61,12 +66,24 @@ class KvRouter(AsyncEngine):
         await self.indexer.stop()
         await self.aggregator.stop()
 
-    async def schedule(self, token_ids: list[int]) -> RouterResponse:
+    async def schedule(
+        self, token_ids: list[int], exclude: set[int] | frozenset[int] = frozenset()
+    ) -> RouterResponse:
+        """Pick a worker; ``exclude`` drops ids the caller knows are bad
+        right now (failed this request, breaker-open, draining)."""
         await self.start()
         with trace_span("kv_route", isl_tokens=len(token_ids)) as sp:
             endpoints = self.aggregator.endpoints
             if not endpoints.metrics:
                 endpoints = await self.aggregator.scrape_once()
+            if exclude:
+                endpoints = ProcessedEndpoints(
+                    metrics={
+                        w: m
+                        for w, m in endpoints.metrics.items()
+                        if w not in exclude
+                    }
+                )
             overlaps = self.indexer.find_matches_for_request(token_ids)
             worker_id, overlap = self.selector.select_worker(
                 endpoints, overlaps, len(token_ids), self.block_size
@@ -101,7 +118,12 @@ class KvRouter(AsyncEngine):
 
 class KvPushRouter(AsyncEngine):
     """Route KV-aware, then push to the chosen worker instance — the
-    drop-in engine the ingress uses when router-mode=kv."""
+    drop-in engine the ingress uses when router-mode=kv.
+
+    Failover stays KV-aware: a connection/stream-start failure re-runs
+    the selector over the remaining workers (failed + unhealthy +
+    draining excluded) instead of falling back to random choice, so the
+    retry still lands on the best surviving prefix overlap."""
 
     def __init__(self, push_router: PushRouter, kv_router: KvRouter):
         self.push = push_router
@@ -114,13 +136,32 @@ class KvPushRouter(AsyncEngine):
         token_ids = (
             request.get("token_ids", []) if isinstance(request, dict) else []
         )
-        resp = await self.kv.schedule(token_ids)
-        if isinstance(request, dict):
-            request = dict(request)
-            request["estimated_prefix_hit_num_blocks"] = resp.overlap_blocks
-        return await self.push.generate_direct(
-            request, instance_id=resp.worker_id, context=ctx
-        )
+        failed: set[int] = set()
+        attempt = 0
+        while True:
+            ctx.check_deadline("router")
+            try:
+                resp = await self.kv.schedule(
+                    token_ids, exclude=failed | self.push.unavailable_ids()
+                )
+            except NoWorkersError as e:
+                raise NoHealthyInstancesError(str(e)) from e
+            routed = request
+            if isinstance(request, dict):
+                routed = dict(request)
+                routed["estimated_prefix_hit_num_blocks"] = resp.overlap_blocks
+            try:
+                return await self.push.generate_direct(
+                    routed, instance_id=resp.worker_id, context=ctx
+                )
+            except ConnectionError:
+                # The push router already recorded the failure against
+                # the instance; re-select among the survivors.
+                failed.add(resp.worker_id)
+                attempt += 1
+                if attempt > self.push.retries:
+                    raise
+                await self.push.sleep_backoff(attempt, ctx)
 
 
 async def build_routed_core(endpoint, mode: RouterMode, block_size: int):

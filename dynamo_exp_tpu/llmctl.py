@@ -170,6 +170,15 @@ def build_parser() -> argparse.ArgumentParser:
     dset.add_argument("--max-local-prefill-length", type=int, required=True)
     dset.add_argument("--max-prefill-queue-size", type=int, default=2)
 
+    # Graceful drain: publish drain intent for an instance. The serving
+    # process watches the drain prefix, republishes itself with
+    # ``draining`` metadata (routers stop sending new work on their next
+    # discovery snapshot), and finishes in-flight requests.
+    drain = sub.add_parser(
+        "drain", help="gracefully drain a worker instance (stop new work)"
+    )
+    drain.add_argument("instance_id", type=int)
+
     # Offline trace reconstruction from the telemetry recorder JSONL
     # (``DYN_TRACE_FILE``): no argument lists recorded traces; with a
     # trace_id (full/prefix) or request id, pretty-prints its span tree.
@@ -218,6 +227,24 @@ def run_trace(args) -> int:
     return 0
 
 
+async def drain_instance(drt, args) -> int:
+    from .runtime.component import DRAIN_PREFIX
+
+    live = {
+        i.instance_id
+        for i in await drt.discovery.list_instances("")
+    }
+    if args.instance_id not in live:
+        print(f"instance {args.instance_id} is not live", file=sys.stderr)
+        return 1
+    await drt.discovery.kv_put(f"{DRAIN_PREFIX}{args.instance_id}", b"1")
+    print(
+        f"drain requested for instance {args.instance_id}; routers stop "
+        "sending new work once the worker republishes its metadata"
+    )
+    return 0
+
+
 async def get_disagg(drt, args) -> int:
     from .disagg.config import DisaggConfig, disagg_config_key
 
@@ -252,6 +279,8 @@ async def run(args) -> int:
         config=RuntimeConfig(coordinator_endpoint=args.coordinator)
     )
     try:
+        if args.plane == "drain":
+            return await drain_instance(drt, args)
         if args.plane == "disagg":
             if args.command == "get":
                 return await get_disagg(drt, args)

@@ -3,6 +3,7 @@
 from .annotated import Annotated
 from .client import Client, EngineError
 from .component import (
+    DRAIN_PREFIX,
     Component,
     DistributedRuntime,
     Endpoint,
@@ -14,9 +15,11 @@ from .config import RuntimeConfig
 from .engine import (
     AsyncEngine,
     AsyncEngineContext,
+    DeadlineExceededError,
     LambdaEngine,
     ResponseStream,
 )
+from .health import BreakerState, CircuitBreaker, HealthTracker, is_draining
 from .logging import configure_logging
 from .pipeline import (
     Context,
@@ -32,7 +35,12 @@ from .pipeline import (
     build_segment,
 )
 from .pool import Pool, PoolItem
-from .push_router import NoInstancesError, PushRouter, RouterMode
+from .push_router import (
+    NoHealthyInstancesError,
+    NoInstancesError,
+    PushRouter,
+    RouterMode,
+)
 from .runtime import CancellationToken, Runtime, Worker
 from .transports.base import EndpointAddress, InstanceInfo, Lease
 
@@ -40,19 +48,25 @@ __all__ = [
     "Annotated",
     "AsyncEngine",
     "AsyncEngineContext",
+    "BreakerState",
     "CancellationToken",
+    "CircuitBreaker",
     "Client",
     "Component",
     "Context",
+    "DRAIN_PREFIX",
+    "DeadlineExceededError",
     "DistributedRuntime",
     "Endpoint",
     "EndpointAddress",
     "EngineError",
+    "HealthTracker",
     "InstanceInfo",
     "LambdaEngine",
     "Lease",
     "MapOperator",
     "Namespace",
+    "NoHealthyInstancesError",
     "NoInstancesError",
     "Operator",
     "PipelineNode",
@@ -74,4 +88,5 @@ __all__ = [
     "build_pipeline",
     "build_segment",
     "configure_logging",
+    "is_draining",
 ]

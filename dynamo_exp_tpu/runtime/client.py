@@ -4,25 +4,41 @@ Capability parity with ``/root/reference/lib/runtime/src/component/client.rs``:
 a dynamic client watches discovery for membership changes (lease expiry
 drops instances instantly); a static client uses a fixed instance list.
 Routing policies live in :mod:`push_router`.
+
+Every client owns a :class:`~dynamo_exp_tpu.runtime.health.HealthTracker`:
+discovery snapshots stamp liveness into it here, request outcomes are
+recorded into it by the router. The discovery watch loop survives stream
+errors — it logs, re-subscribes with capped exponential backoff, and
+re-lists instances on resume so a flapping control plane degrades to a
+slightly stale view instead of a silently frozen one.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 from typing import AsyncIterator
 
 from .annotated import Annotated
 from .engine import AsyncEngineContext
+from .health import HealthTracker
 from .runtime import Runtime
 from .transports.base import Discovery, InstanceInfo, RequestPlane
 
 logger = logging.getLogger(__name__)
 
+# Watch-resubscribe backoff bounds (seconds).
+_WATCH_BACKOFF_INITIAL_S = 0.05
+_WATCH_BACKOFF_MAX_S = 2.0
+
 
 class Client:
-    def __init__(self, request_plane: RequestPlane):
+    def __init__(
+        self, request_plane: RequestPlane, health: HealthTracker | None = None
+    ):
         self.request_plane = request_plane
+        self.health = health or HealthTracker()
         self._instances: list[InstanceInfo] = []
         self._changed = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
@@ -30,11 +46,13 @@ class Client:
     # --- construction -------------------------------------------------
     @classmethod
     def new_static(
-        cls, request_plane: RequestPlane, instances: list[InstanceInfo]
+        cls,
+        request_plane: RequestPlane,
+        instances: list[InstanceInfo],
+        health: HealthTracker | None = None,
     ) -> "Client":
-        c = cls(request_plane)
-        c._instances = list(instances)
-        c._changed.set()
+        c = cls(request_plane, health=health)
+        c._apply_snapshot(list(instances))
         return c
 
     @classmethod
@@ -44,19 +62,51 @@ class Client:
         discovery: Discovery,
         request_plane: RequestPlane,
         endpoint_path: str,
+        health: HealthTracker | None = None,
     ) -> "Client":
-        c = cls(request_plane)
+        c = cls(request_plane, health=health)
 
         async def _watch() -> None:
-            async for snapshot in discovery.watch_instances(endpoint_path):
-                c._instances = snapshot
-                c._changed.set()
+            backoff = _WATCH_BACKOFF_INITIAL_S
+            while True:
+                try:
+                    async for snapshot in discovery.watch_instances(endpoint_path):
+                        backoff = _WATCH_BACKOFF_INITIAL_S
+                        c._apply_snapshot(snapshot)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 - watch must survive
+                    logger.warning(
+                        "discovery watch for %s failed (%s: %s); "
+                        "re-subscribing in %.2fs",
+                        endpoint_path, type(e).__name__, e, backoff,
+                    )
+                else:
+                    # The stream ended without error (control plane closed
+                    # it); treat like a flap and re-subscribe.
+                    logger.warning(
+                        "discovery watch for %s ended; re-subscribing in %.2fs",
+                        endpoint_path, backoff,
+                    )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, _WATCH_BACKOFF_MAX_S)
+                # Re-list on resume: membership changes during the gap
+                # produced no watch push, so the snapshot must be pulled.
+                with contextlib.suppress(Exception):
+                    c._apply_snapshot(
+                        await discovery.list_instances(endpoint_path)
+                    )
 
-        c._instances = await discovery.list_instances(endpoint_path)
+        c._apply_snapshot(await discovery.list_instances(endpoint_path))
         c._watch_task = runtime.spawn(_watch())
         return c
 
     # --- membership ---------------------------------------------------
+    def _apply_snapshot(self, snapshot: list[InstanceInfo]) -> None:
+        self._instances = snapshot
+        self.health.observe_instances(snapshot)
+        self._changed.set()
+
     @property
     def instances(self) -> list[InstanceInfo]:
         return self._instances

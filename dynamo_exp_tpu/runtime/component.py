@@ -10,6 +10,8 @@ so that worker death removes the instance automatically.
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import logging
 from typing import AsyncIterator
 
@@ -33,6 +35,12 @@ from .transports.base import (
 from .transports.inproc import InProcDiscovery, InProcRequestPlane
 
 logger = logging.getLogger(__name__)
+
+# KV prefix where drain intent is published: ``llmctl drain <instance>``
+# writes ``{DRAIN_PREFIX}{instance_id}``; the serving process watches the
+# prefix and initiates its own graceful drain (the worker owns its lease,
+# so the operator plane never has to forge registrations).
+DRAIN_PREFIX = "v1/drain/"
 
 # Endpoints served under one lease, for composing unique instance ids.
 # Per-lease (not process-global): a long-lived process serving many
@@ -195,10 +203,19 @@ class Component:
     def endpoint(self, name: str) -> "Endpoint":
         return Endpoint(self, name)
 
-    async def scrape_stats(self) -> dict[int, dict]:
-        """Collect live stats from every instance of this component."""
+    async def scrape_stats(self, include_draining: bool = True) -> dict[int, dict]:
+        """Collect live stats from every instance of this component.
+
+        ``include_draining=False`` drops instances that advertised drain
+        in their discovery metadata — selection planes (the KV router's
+        metrics aggregator) must not schedule onto them.
+        """
+        from .health import is_draining
+
         out: dict[int, dict] = {}
         for info in await self.drt.discovery.list_instances(self.path):
+            if not include_draining and is_draining(info):
+                continue
             try:
                 out[info.instance_id] = await self.drt.request_plane.scrape_stats(info)
             except ConnectionError:
@@ -252,14 +269,28 @@ class Endpoint:
         served = await drt.request_plane.serve(info, handler, stats_handler)
         await drt.discovery.register_instance(info, lease)
         logger.info("serving endpoint %s as instance %d", self.path, info.instance_id)
-        return ServedInstance(self, info, served, lease)
+        instance = ServedInstance(self, info, served, lease)
+        instance._start_drain_watch()
+        return instance
 
-    async def client(self, static_instances: list[InstanceInfo] | None = None) -> Client:
-        """A client that tracks this endpoint's live instances."""
+    async def client(
+        self,
+        static_instances: list[InstanceInfo] | None = None,
+        health=None,
+    ) -> Client:
+        """A client that tracks this endpoint's live instances. ``health``
+        overrides the default HealthTracker (custom breaker thresholds,
+        injectable clock under test)."""
         if static_instances is not None:
-            return Client.new_static(self.drt.request_plane, static_instances)
+            return Client.new_static(
+                self.drt.request_plane, static_instances, health=health
+            )
         return await Client.new_dynamic(
-            self.drt.runtime, self.drt.discovery, self.drt.request_plane, self.path
+            self.drt.runtime,
+            self.drt.discovery,
+            self.drt.request_plane,
+            self.path,
+            health=health,
         )
 
 
@@ -275,10 +306,68 @@ class ServedInstance:
         self.info = info
         self._served = served
         self.lease = lease
+        self._drain_task = None
 
     @property
     def instance_id(self) -> int:
         return self.info.instance_id
+
+    @property
+    def is_draining(self) -> bool:
+        from .health import is_draining
+
+        return is_draining(self.info)
+
+    def _start_drain_watch(self) -> None:
+        """Watch the drain-intent KV prefix so ``llmctl drain <id>`` can
+        trigger a graceful drain without owning this worker's lease."""
+        drt = self.endpoint.drt
+
+        async def _watch() -> None:
+            key = f"{DRAIN_PREFIX}{self.info.instance_id}"
+            try:
+                async for snapshot in drt.discovery.kv_watch_prefix(DRAIN_PREFIX):
+                    if key in snapshot:
+                        await self.drain()
+                        # Consume the intent: the key has done its job,
+                        # and leaving it would grow the drain prefix
+                        # forever (and re-ship stale keys to every
+                        # instance's watcher on each KV change).
+                        with contextlib.suppress(Exception):
+                            await drt.discovery.kv_delete(key)
+                        return
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a broken control-plane watch
+                # must not kill serving; drain stays operator-reachable
+                # via ServedInstance.drain() in-process.
+                logger.debug(
+                    "drain watch for instance %d ended",
+                    self.info.instance_id,
+                    exc_info=True,
+                )
+
+        self._drain_task = drt.spawn_background(
+            _watch(), name=f"drain-watch-{self.info.instance_id}"
+        )
+
+    async def drain(self) -> None:
+        """Signal drain: republish this instance with ``draining`` set in
+        its discovery metadata. Routers stop sending new work on their
+        next snapshot; in-flight requests keep streaming. Call
+        :meth:`close` afterwards to wait them out and deregister."""
+        if self.info.metadata.get("draining"):
+            return
+        from ..telemetry import get_telemetry
+
+        self.info.metadata = {**self.info.metadata, "draining": True}
+        await self.endpoint.drt.discovery.register_instance(self.info, self.lease)
+        get_telemetry().drain_events.labels("started").inc()
+        logger.info(
+            "instance %d draining (endpoint %s)",
+            self.info.instance_id,
+            self.endpoint.path,
+        )
 
     async def close(self, revoke_lease: bool | None = None) -> None:
         """Stop serving: drop from discovery first, then drain inflight
@@ -289,6 +378,9 @@ class ServedInstance:
         ride on) is left alone and just this instance is deregistered.
         """
         drt = self.endpoint.drt
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+            self._drain_task = None
         if revoke_lease is None:
             revoke_lease = self.lease is not drt._primary_lease
         if revoke_lease and self.lease.is_valid():
@@ -296,6 +388,10 @@ class ServedInstance:
         else:
             await drt.discovery.deregister_instance(self.info.instance_id)
         await self._served.close()
+        if self.is_draining:
+            from ..telemetry import get_telemetry
+
+            get_telemetry().drain_events.labels("completed").inc()
 
 
 def _validate_segment(name: str) -> None:

@@ -12,6 +12,7 @@ Reference capability: ``/root/reference/lib/runtime/src/engine.rs:46-128``.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
 from typing import Any, AsyncIterator, Generic, Protocol, TypeVar, runtime_checkable
 
@@ -19,13 +20,54 @@ Req = TypeVar("Req", contravariant=True)
 Resp = TypeVar("Resp", covariant=True)
 
 
-class AsyncEngineContext:
-    """Per-request control handle carried alongside the response stream."""
+class DeadlineExceededError(TimeoutError):
+    """The request's end-to-end deadline expired before it completed."""
 
-    def __init__(self, request_id: str | None = None):
+
+class AsyncEngineContext:
+    """Per-request control handle carried alongside the response stream.
+
+    Besides cooperative stop/kill, the context optionally carries an
+    end-to-end **deadline** (unix seconds). Routers refuse to dispatch
+    and remote stages refuse to start work once it passes; the TCP
+    request plane and the disagg prefill queue propagate it as a
+    remaining-time budget so clock skew between hosts doesn't matter.
+    """
+
+    def __init__(
+        self, request_id: str | None = None, deadline: float | None = None
+    ):
         self.id = request_id or uuid.uuid4().hex
+        self.deadline = deadline
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
+
+    # --- deadline -----------------------------------------------------
+    def start_timeout(self, timeout_s: float | None) -> None:
+        """Arm the deadline ``timeout_s`` seconds from now (None = no-op)."""
+        if timeout_s is not None:
+            self.deadline = time.time() + timeout_s
+
+    def time_remaining(self) -> float | None:
+        """Seconds until the deadline (may be negative); None if unset."""
+        if self.deadline is None:
+            return None
+        return self.deadline - time.time()
+
+    @property
+    def deadline_expired(self) -> bool:
+        return self.deadline is not None and time.time() >= self.deadline
+
+    def check_deadline(self, stage: str = "router") -> None:
+        """Raise :class:`DeadlineExceededError` if the deadline passed,
+        recording the abandoning stage on the telemetry counter."""
+        if self.deadline_expired:
+            from ..telemetry import get_telemetry
+
+            get_telemetry().deadline_exceeded.labels(stage).inc()
+            raise DeadlineExceededError(
+                f"request {self.id} deadline exceeded at stage {stage!r}"
+            )
 
     def stop_generating(self) -> None:
         """Ask the generator to stop gracefully after the current step."""

@@ -5,17 +5,37 @@ Capability parity with
 random / round-robin / direct(instance) / static routing, presented as an
 AsyncEngine so routers compose with pipelines. KV-aware routing lives in
 :mod:`dynamo_exp_tpu.router` and plugs in via ``RouterMode.DIRECT``.
+
+Fault tolerance (docs/fault_tolerance.md): selection skips draining and
+breaker-blocked instances (the client's
+:class:`~dynamo_exp_tpu.runtime.health.HealthTracker`); a
+**connection/stream-start** failure — the transport refused, or the
+stream died before its first frame — is retried with exponential backoff
++ jitter against a *different* instance, up to ``retries`` times and
+never past the request's deadline. Once the first frame has arrived the
+stream is committed to its instance: mid-stream failures always surface
+to the caller (re-issuing could duplicate tokens). In-band error frames
+(``EngineError``) are application errors, not transport errors, and are
+never retried either.
 """
 
 from __future__ import annotations
 
+import asyncio
 import enum
 import itertools
 import random
 from typing import Any, AsyncIterator
 
+from ..telemetry import get_telemetry
+from .annotated import Annotated
 from .client import Client
-from .engine import AsyncEngine, AsyncEngineContext, ResponseStream
+from .engine import (
+    AsyncEngine,
+    AsyncEngineContext,
+    DeadlineExceededError,
+    ResponseStream,
+)
 from .transports.base import InstanceInfo
 
 
@@ -31,6 +51,11 @@ class NoInstancesError(ConnectionError):
     pass
 
 
+class NoHealthyInstancesError(NoInstancesError):
+    """Instances exist, but every one is draining, breaker-open, or
+    already tried this request — the 503 + Retry-After case."""
+
+
 class PushRouter(AsyncEngine[dict, Any]):
     """Routes each request to one live instance of a remote endpoint."""
 
@@ -39,6 +64,10 @@ class PushRouter(AsyncEngine[dict, Any]):
         client: Client,
         mode: RouterMode = RouterMode.RANDOM,
         ready_wait_s: float = 0.0,
+        retries: int = 2,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 2.0,
+        rng: random.Random | None = None,
     ):
         self.client = client
         self.mode = mode
@@ -46,13 +75,29 @@ class PushRouter(AsyncEngine[dict, Any]):
         # this long for one instead of failing (ingress/graph startup
         # races); 0 keeps the strict fail-fast default.
         self.ready_wait_s = ready_wait_s
+        self.retries = retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        # Injectable rng keeps backoff jitter deterministic under test.
+        self.rng = rng or random.Random()
         self._rr = itertools.count()
 
-    def _pick(self, request: dict) -> InstanceInfo:
+    @property
+    def health(self):
+        return self.client.health
+
+    def unavailable_ids(self) -> set[int]:
+        """Live instance ids currently excluded from selection."""
+        return self.health.unavailable_ids(self.client.instances)
+
+    def _pick(
+        self, request: dict, exclude: frozenset[int] | set[int] = frozenset()
+    ) -> InstanceInfo:
         instances = self.client.instances
         if not instances:
             raise NoInstancesError("no live instances for endpoint")
-        # An explicit target always wins, regardless of mode.
+        # An explicit target always wins, regardless of mode — KV-aware
+        # callers (KvPushRouter) do their own health-filtered selection.
         if "_worker_instance_id" in request:
             try:
                 return self.client.instance(int(request["_worker_instance_id"]))
@@ -60,15 +105,41 @@ class PushRouter(AsyncEngine[dict, Any]):
                 # Stale target (lease expired) is a routing error, so callers
                 # can retry/503 with one except clause.
                 raise NoInstancesError(str(e)) from e
+        pool = [
+            i
+            for i in self.health.filter_available(instances)
+            if i.instance_id not in exclude
+        ]
+        if not pool:
+            raise NoHealthyInstancesError(
+                f"no healthy instances for endpoint "
+                f"({len(instances)} live, all draining/unhealthy/tried)"
+            )
         if self.mode is RouterMode.RANDOM:
-            return random.choice(instances)
+            return self.rng.choice(pool)
         if self.mode is RouterMode.ROUND_ROBIN:
-            return instances[next(self._rr) % len(instances)]
+            return pool[next(self._rr) % len(pool)]
         if self.mode in (RouterMode.DIRECT, RouterMode.KV):
             # The explicit-target branch above handles present ids.
             raise ValueError("direct routing requires _worker_instance_id")
         # STATIC: single fixed instance
-        return instances[0]
+        return pool[0]
+
+    async def sleep_backoff(
+        self, attempt: int, ctx: AsyncEngineContext
+    ) -> None:
+        """Exponential backoff with 50% jitter, capped by the deadline.
+        Public: KV-aware wrappers reuse this policy for their own
+        re-selecting retry loops."""
+        delay = min(
+            self.backoff_base_s * (2 ** (attempt - 1)), self.backoff_max_s
+        )
+        delay *= 0.5 + self.rng.random() / 2
+        remaining = ctx.time_remaining()
+        if remaining is not None:
+            delay = min(delay, max(remaining, 0.0))
+        if delay > 0:
+            await asyncio.sleep(delay)
 
     async def generate(
         self, request: dict, context: AsyncEngineContext | None = None
@@ -79,11 +150,54 @@ class PushRouter(AsyncEngine[dict, Any]):
                 await self.client.wait_for_instances(1, self.ready_wait_s)
             except TimeoutError:
                 pass  # fall through to the strict error below
-        instance = self._pick(request)
-        request = {k: v for k, v in request.items() if k != "_worker_instance_id"}
-        frames = await self.client.generate_to(instance, request, ctx)
+        explicit_target = "_worker_instance_id" in request
+        clean = {k: v for k, v in request.items() if k != "_worker_instance_id"}
+        tried: set[int] = set()
+        attempt = 0
+        while True:
+            ctx.check_deadline("router")
+            instance = self._pick(request, exclude=tried)
+            self.health.acquire(instance.instance_id)
+            try:
+                frames = await self.client.generate_to(instance, clean, ctx)
+                first = await _pull_first(frames)
+            except ConnectionError as e:
+                # Stream-start failure: the instance never produced a
+                # frame, so failing over cannot duplicate output.
+                self.health.record_failure(instance.instance_id)
+                tried.add(instance.instance_id)
+                attempt += 1
+                if explicit_target or attempt > self.retries:
+                    raise
+                get_telemetry().request_retries.labels(
+                    "connect" if _is_connect_error(e) else "stream_start"
+                ).inc()
+                await self.sleep_backoff(attempt, ctx)
+                continue
+            if (
+                first is not None
+                and first.is_error()
+                and ctx.deadline_expired
+            ):
+                # The deadline expired in transit and the remote plane
+                # refused in-band. That is neither an instance failure
+                # nor an application error — surface it as the deadline
+                # it is (HTTP maps this to 504, not 500).
+                raise DeadlineExceededError(
+                    first.error_message()
+                    or f"request {ctx.id} deadline exceeded at request plane"
+                )
+            self.health.record_success(instance.instance_id)
+            break
 
         async def _data() -> AsyncIterator[Any]:
+            if first is not None:
+                if first.is_error():
+                    from .client import EngineError
+
+                    raise EngineError(first.error_message() or "remote error")
+                if first.data is not None:
+                    yield first.data
             async for ann in frames:
                 if ann.data is not None:
                     yield ann.data
@@ -99,3 +213,30 @@ class PushRouter(AsyncEngine[dict, Any]):
         return await self.generate(
             {**request, "_worker_instance_id": instance_id}, context
         )
+
+
+async def _pull_first(frames: AsyncIterator[Annotated]) -> Annotated | None:
+    """Eagerly pull the stream's first frame so stream-start failures are
+    observable inside the retry loop. Error frames are returned (not
+    raised): an in-band error means the stream *started* — it is an
+    application failure, outside the failover contract. Returns None for
+    a clean empty stream."""
+    try:
+        return await anext(aiter(frames))
+    except StopAsyncIteration:
+        return None
+    except Exception as e:
+        # Client.generate_to raises EngineError for error frames; convert
+        # the first-frame case back to a frame so the retry loop's
+        # ConnectionError filter stays precise.
+        from .client import EngineError
+
+        if isinstance(e, EngineError):
+            return Annotated.from_error(str(e))
+        raise
+
+
+def _is_connect_error(e: Exception) -> bool:
+    """Connect-phase errors mention the transport; stream drops happen
+    after dispatch. Best-effort label for the retry counter."""
+    return "connect" in str(e).lower() or "no served endpoint" in str(e).lower()

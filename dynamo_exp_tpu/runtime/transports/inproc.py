@@ -205,6 +205,20 @@ class InProcRequestPlane(RequestPlane):
             )
         handler, _, inflight = entry
         await self.latency.delay()
+        if context.deadline_expired:
+            # Parity with the TCP plane: an expired request is refused
+            # in-band before the handler runs.
+            from ...telemetry import get_telemetry
+
+            get_telemetry().deadline_exceeded.labels("request_plane").inc()
+
+            async def _expired() -> AsyncIterator[dict]:
+                yield {
+                    "event": "error",
+                    "comment": [f"deadline exceeded for request {context.id}"],
+                }
+
+            return _expired()
 
         # Count the request as inflight from dispatch (not first iteration),
         # so graceful drain can't miss a just-dispatched request.

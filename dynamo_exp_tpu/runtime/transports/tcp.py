@@ -30,7 +30,13 @@ import logging
 import weakref
 from typing import AsyncIterator
 
-from ...telemetry import TraceContext, attach as trace_attach, detach as trace_detach, wire_headers
+from ...telemetry import (
+    TraceContext,
+    attach as trace_attach,
+    detach as trace_detach,
+    get_telemetry,
+    wire_headers,
+)
 from ..engine import AsyncEngineContext
 from .base import (
     Handler,
@@ -156,6 +162,23 @@ class TcpRequestPlane(RequestPlane):
         handler, _, inflight = entry
         request = json.loads(msg.payload) if msg.payload else {}
         context = AsyncEngineContext(request_id=msg.header.get("request_id"))
+        # Deadline propagation: the caller ships its *remaining* budget
+        # (not an absolute timestamp), so host clock skew can't shrink or
+        # grow the window. An already-expired request is refused before
+        # the handler runs — the remote stage must not waste work on it.
+        timeout_s = msg.header.get("timeout_s")
+        if timeout_s is not None:
+            context.start_timeout(float(timeout_s))
+        if context.deadline_expired:
+            get_telemetry().deadline_exceeded.labels("request_plane").inc()
+            await write_message(
+                writer,
+                TwoPartMessage(
+                    MsgType.ERROR,
+                    {"message": f"deadline exceeded for request {context.id}"},
+                ),
+            )
+            return
         # Cross-process trace continuation: the caller's trace context
         # rides the request header; adopt it so every span/log emitted
         # while handling joins the caller's trace.
@@ -226,6 +249,9 @@ class TcpRequestPlane(RequestPlane):
         trace = wire_headers()
         if trace:
             header["trace"] = trace
+        remaining = context.time_remaining()
+        if remaining is not None:
+            header["timeout_s"] = max(remaining, 0.0)
         await write_message(
             writer,
             TwoPartMessage(MsgType.REQUEST, header, json.dumps(request).encode()),

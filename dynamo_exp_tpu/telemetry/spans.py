@@ -154,6 +154,33 @@ class Telemetry:
             key: Gauge(f"dynamo_engine_{key}", help_, registry=self.registry)
             for key, help_ in _ENGINE_GAUGES
         }
+        # Fault-tolerance counters (docs/fault_tolerance.md): retries and
+        # failovers on the request plane, circuit-breaker churn, requests
+        # abandoned at their deadline per stage, and drain lifecycle.
+        self.request_retries = Counter(
+            "dynamo_request_retries_total",
+            "Request-plane retries after connection/stream-start failures",
+            ["reason"],  # connect | stream_start
+            registry=self.registry,
+        )
+        self.breaker_transitions = Counter(
+            "dynamo_circuit_breaker_transitions_total",
+            "Circuit-breaker state transitions across all tracked targets",
+            ["state"],  # open | half_open | closed
+            registry=self.registry,
+        )
+        self.deadline_exceeded = Counter(
+            "dynamo_deadline_exceeded_total",
+            "Requests abandoned because their end-to-end deadline expired",
+            ["stage"],  # router | request_plane | prefill_queue | decode
+            registry=self.registry,
+        )
+        self.drain_events = Counter(
+            "dynamo_drain_events_total",
+            "Graceful-drain lifecycle events on served instances",
+            ["event"],  # started | completed
+            registry=self.registry,
+        )
 
     # ------------------------------------------------------------ recorder
     def configure(self, trace_file: str | None) -> None:

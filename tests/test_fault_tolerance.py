@@ -1,0 +1,664 @@
+"""Fault-tolerance suite: the request plane under deterministic chaos.
+
+Every scenario runs against the seeded fault-injection harness
+(``runtime/transports/chaos.py``) wrapped around the in-proc planes, so
+failures fire at exact, reproducible points:
+
+- worker crash at stream start → failover retry, same result;
+- worker crash mid-stream → NO retry (tokens must not duplicate);
+- consecutive failures → circuit breaker opens, half-open probe closes
+  it after the cooldown;
+- prefill-fleet death → decode degrades to local prefill, the remote
+  path's breaker opens (no more transfer-timeout burns), and recovery
+  closes it;
+- deadline expiry at each stage (router, request plane, prefill queue)
+  stops the work before it is wasted;
+- graceful drain (``llmctl drain`` KV intent → worker metadata) removes
+  an instance from routing with zero failed in-flight requests;
+- discovery watch flaps → the client re-subscribes and re-lists.
+
+Run with ``make chaos`` (three fixed seeds) or plain pytest
+(``-m chaos``). Seeds come from ``CHAOS_SEEDS`` (comma-separated) so CI
+can sweep them without editing the file.
+"""
+
+import asyncio
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.runtime import (
+    DRAIN_PREFIX,
+    Annotated,
+    AsyncEngineContext,
+    BreakerState,
+    Client,
+    DeadlineExceededError,
+    DistributedRuntime,
+    EngineError,
+    HealthTracker,
+    NoHealthyInstancesError,
+    PushRouter,
+    ResponseStream,
+    RouterMode,
+)
+from dynamo_exp_tpu.runtime.health import CircuitBreaker
+from dynamo_exp_tpu.runtime.transports.chaos import (
+    ChaosDiscovery,
+    ChaosRequestPlane,
+    ChaosSchedule,
+    ChaosWorkQueue,
+)
+from dynamo_exp_tpu.runtime.transports.inproc import (
+    InProcDiscovery,
+    InProcRequestPlane,
+    InProcWorkQueue,
+)
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = tuple(
+    int(s) for s in os.environ.get("CHAOS_SEEDS", "7,21,1337").split(",")
+)
+
+
+# ------------------------------------------------------------------ helpers
+def chaos_runtime(schedule: ChaosSchedule) -> DistributedRuntime:
+    return DistributedRuntime(
+        discovery=ChaosDiscovery(InProcDiscovery(), schedule),
+        request_plane=ChaosRequestPlane(InProcRequestPlane(), schedule),
+    )
+
+
+def make_worker(wid: str, calls: list, tokens=(1, 2, 3), step_delay_s=0.0):
+    async def handler(request, context):
+        calls.append(wid)
+        for t in tokens:
+            if step_delay_s:
+                await asyncio.sleep(step_delay_s)
+            yield Annotated.from_data({"tok": t, "worker": wid}).to_dict()
+
+    return handler
+
+
+async def serve_two_workers(drt, calls, **worker_kw):
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    a = await ep.serve_endpoint(
+        make_worker("a", calls, **worker_kw), lease=await drt.discovery.create_lease()
+    )
+    b = await ep.serve_endpoint(
+        make_worker("b", calls, **worker_kw), lease=await drt.discovery.create_lease()
+    )
+    client = await ep.client()
+    await client.wait_for_instances(2, timeout=2)
+    return ep, a, b, client
+
+
+def fast_router(client, seed=0, **kw):
+    kw.setdefault("mode", RouterMode.ROUND_ROBIN)
+    kw.setdefault("backoff_base_s", 0.001)
+    return PushRouter(client, rng=random.Random(seed), **kw)
+
+
+async def collect(stream):
+    return [item async for item in stream]
+
+
+# ------------------------------------------------ failover on worker crash
+@pytest.mark.parametrize("seed", SEEDS)
+async def test_request_survives_worker_crash_via_failover(seed):
+    """Acceptance: one worker dies at dispatch; the request fails over to
+    the survivor and completes with the same result, one retry counted."""
+    sched = ChaosSchedule(seed)
+    drt = chaos_runtime(sched)
+    calls: list = []
+    _, a, b, client = await serve_two_workers(drt, calls)
+    router = fast_router(client, seed)
+    # Round-robin picks the first-registered instance first; crash it.
+    sched.fail_requests(instance_id=a.instance_id, times=1)
+
+    out = await collect(await router.generate({}))
+
+    assert [o["worker"] for o in out] == ["b", "b", "b"]
+    assert [o["tok"] for o in out] == [1, 2, 3]
+    # Exactly one retry: worker a's handler never ran, b's ran once.
+    assert calls == ["b"]
+    assert sched.injected == [f"request:{a.instance_id}:error"]
+    # The failure registered on a's breaker; one strike, still closed.
+    assert client.health.breaker(a.instance_id).consecutive_failures == 1
+    assert client.health.breaker(a.instance_id).state is BreakerState.CLOSED
+    assert client.health.breaker(b.instance_id).consecutive_failures == 0
+    await drt.close()
+
+
+async def test_no_retry_after_first_token():
+    """A crash after the stream produced output must surface, not retry:
+    re-dispatch would duplicate tokens."""
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    calls: list = []
+    _, a, b, client = await serve_two_workers(drt, calls)
+    router = fast_router(client, retries=5)
+    sched.fail_requests(instance_id=a.instance_id, times=1, after_frames=1)
+
+    stream = await router.generate({})
+    with pytest.raises(ConnectionError, match="stream dropped"):
+        await collect(stream)
+    # Only the crashed worker's handler ran — no failover dispatch.
+    assert calls == ["a"]
+    await drt.close()
+
+
+async def test_failover_exhaustion_surfaces_error():
+    """Both instances dead → the original ConnectionError propagates
+    after `retries` failovers, and both breakers took a strike."""
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    calls: list = []
+    _, a, b, client = await serve_two_workers(drt, calls)
+    router = fast_router(client, retries=1)
+    sched.partition(a.instance_id, b.instance_id)
+
+    with pytest.raises(ConnectionError, match="partition"):
+        await router.generate({})
+    assert calls == []
+    assert client.health.breaker(a.instance_id).consecutive_failures == 1
+    assert client.health.breaker(b.instance_id).consecutive_failures == 1
+    await drt.close()
+
+
+# ----------------------------------------------------------- circuit breaker
+async def test_breaker_opens_blocks_and_recovers_via_half_open_probe():
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    calls: list = []
+    a = await ep.serve_endpoint(make_worker("a", calls))
+    t = [0.0]
+    health = HealthTracker(failure_threshold=3, cooldown_s=5.0, clock=lambda: t[0])
+    client = await ep.client(health=health)
+    await client.wait_for_instances(1, timeout=2)
+    router = fast_router(client, retries=0)
+
+    sched.partition(a.instance_id)
+    for _ in range(3):
+        with pytest.raises(ConnectionError):
+            await router.generate({})
+    breaker = health.breaker(a.instance_id)
+    assert breaker.state is BreakerState.OPEN
+
+    # Open breaker: no dispatch reaches the dead instance at all.
+    dispatches_before = len(sched.injected)
+    with pytest.raises(NoHealthyInstancesError):
+        await router.generate({})
+    assert len(sched.injected) == dispatches_before
+
+    # Instance recovers, cooldown elapses → half-open probe closes it.
+    sched.heal()
+    t[0] = 6.0
+    out = await collect(await router.generate({}))
+    assert [o["worker"] for o in out] == ["a", "a", "a"]
+    assert breaker.state is BreakerState.CLOSED
+    # And stays closed for subsequent traffic.
+    await collect(await router.generate({}))
+    assert calls == ["a", "a"]
+    await drt.close()
+
+
+async def test_half_open_failed_probe_reopens_breaker():
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    a = await ep.serve_endpoint(make_worker("a", []))
+    t = [0.0]
+    health = HealthTracker(failure_threshold=2, cooldown_s=5.0, clock=lambda: t[0])
+    client = await ep.client(health=health)
+    await client.wait_for_instances(1, timeout=2)
+    router = fast_router(client, retries=0)
+
+    sched.partition(a.instance_id)
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            await router.generate({})
+    breaker = health.breaker(a.instance_id)
+    assert breaker.state is BreakerState.OPEN
+    t[0] = 6.0  # cooldown over, instance still dead: probe fails
+    with pytest.raises(ConnectionError):
+        await router.generate({})
+    assert breaker.state is BreakerState.OPEN
+    # Freshly reopened: the very next request is blocked without dispatch.
+    with pytest.raises(NoHealthyInstancesError):
+        await router.generate({})
+    await drt.close()
+
+
+# ------------------------------------------------- disagg prefill-fleet death
+class StubDecodeEngine:
+    """TPUEngine stand-in: real EngineConfig (shape checks stay honest),
+    trivial decode that reports whether remote KV was injected."""
+
+    def __init__(self):
+        from dynamo_exp_tpu.engine import EngineConfig
+        from dynamo_exp_tpu.models import TINY
+
+        self.cfg = EngineConfig(
+            model=TINY,
+            max_decode_slots=2,
+            page_size=8,
+            num_pages=16,
+            max_model_len=128,
+            eos_token_ids=[],
+            kv_dtype="float32",
+        )
+
+    async def generate(self, binput, ctx, remote_kv=None):
+        async def _gen():
+            yield {
+                "token_ids": [remote_kv.first_token if remote_kv else -1],
+                "remote": remote_kv is not None,
+            }
+
+        return ResponseStream(_gen(), ctx)
+
+    def metrics(self):
+        return {}
+
+
+def make_disagg(sched, transfer_timeout_s=0.05, breaker=None):
+    from dynamo_exp_tpu.disagg import (
+        DisaggConfig,
+        DisaggConfigWatcher,
+        DisaggDecodeEngine,
+        KvPageReceiver,
+    )
+
+    inner_queue = InProcWorkQueue()
+    queue = ChaosWorkQueue(inner_queue, sched)
+    recv = KvPageReceiver()
+    watcher = DisaggConfigWatcher(
+        InProcDiscovery(), "m", default=DisaggConfig(max_local_prefill_length=0)
+    )
+    engine = DisaggDecodeEngine(
+        StubDecodeEngine(),
+        queue,
+        recv,
+        watcher,
+        transfer_timeout_s=transfer_timeout_s,
+        breaker=breaker,
+    )
+    return engine, inner_queue, recv
+
+
+async def run_one(engine, n_tokens=20):
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    b = BackendInput(token_ids=list(range(3, 3 + n_tokens)))
+    stream = await engine.generate(b.to_dict())
+    return (await collect(stream))[0]
+
+
+async def fake_prefill_service(inner_queue, cfg, first_token=9):
+    """Pull one request and ship correctly-shaped zero pages back."""
+    from dynamo_exp_tpu.disagg import RemotePrefillRequest, send_kv_pages
+
+    raw = await inner_queue.pull(timeout_s=2)
+    assert raw is not None
+    req = RemotePrefillRequest.from_bytes(raw)
+    need = (len(req.token_ids) + cfg.page_size - 1) // cfg.page_size
+    shape = (cfg.model.num_layers, cfg.page_size, cfg.model.num_kv_heads * cfg.model.head_dim_)
+    pages = [
+        (np.zeros(shape, np.float32), np.zeros(shape, np.float32))
+        for _ in range(need)
+    ]
+    await send_kv_pages(req.return_addr, req.request_id, first_token, pages)
+
+
+async def test_prefill_fleet_death_degrades_to_local_and_breaker_recovers():
+    """Acceptance: queue outage → local prefill (requests still finish),
+    breaker opens after the threshold (no more queue pushes / timeout
+    burns), and a healed fleet closes it via the half-open probe."""
+    sched = ChaosSchedule(SEEDS[0])
+    t = [0.0]
+    breaker = CircuitBreaker(failure_threshold=3, cooldown_s=5.0, clock=lambda: t[0])
+    engine, inner_queue, recv = make_disagg(sched, breaker=breaker)
+    await recv.start()
+    try:
+        sched.fail_queue("push", times=-1)
+        for _ in range(3):
+            out = await run_one(engine)
+            assert out["remote"] is False  # degraded, not dead
+        assert engine.local_fallbacks == 3
+        assert breaker.state is BreakerState.OPEN
+
+        # Breaker open: the remote path is not even attempted.
+        pushes_before = sum(1 for i in sched.injected if i.startswith("push"))
+        out = await run_one(engine)
+        assert out["remote"] is False
+        assert (
+            sum(1 for i in sched.injected if i.startswith("push")) == pushes_before
+        )
+        assert engine.local_fallbacks == 3  # no timeout burn, no fallback count
+
+        # Fleet recovers + cooldown elapses: probe goes remote and closes.
+        sched.clear()
+        t[0] = 6.0
+        service = asyncio.ensure_future(
+            fake_prefill_service(inner_queue, engine.engine.cfg)
+        )
+        out = await run_one(engine)
+        await asyncio.wait_for(service, 5)
+        assert out["remote"] is True and out["token_ids"] == [9]
+        assert breaker.state is BreakerState.CLOSED
+        assert engine.remote_prefills == 1
+    finally:
+        await recv.close()
+
+
+async def test_short_deadline_timeout_does_not_blame_prefill_fleet():
+    """A transfer wait cut short by the request's own deadline must not
+    count toward the remote-prefill breaker: three short-deadline
+    requests would otherwise lock a healthy fleet out for a cooldown."""
+    sched = ChaosSchedule(SEEDS[0])
+    engine, inner_queue, recv = make_disagg(sched, transfer_timeout_s=60.0)
+    await recv.start()
+    try:
+        from dynamo_exp_tpu.protocols.common import BackendInput
+
+        for _ in range(3):
+            ctx = AsyncEngineContext()
+            ctx.start_timeout(0.05)  # expires during the transfer wait
+            b = BackendInput(token_ids=list(range(3, 23)))
+            stream = await engine.generate(b.to_dict(), ctx)
+            out = (await collect(stream))[0]
+            assert out["remote"] is False  # fell back locally
+            # Drain the unserviced item so the queue-depth gate doesn't
+            # veto the next remote attempt.
+            assert await inner_queue.pull(timeout_s=0.5) is not None
+        assert engine.local_fallbacks == 3
+        assert engine.breaker.state is BreakerState.CLOSED
+        assert engine.breaker.consecutive_failures == 0
+    finally:
+        await recv.close()
+
+
+async def test_queue_size_outage_means_prefill_locally():
+    """Satellite: a broken queue.size() must not crash the request — the
+    decision degrades to local prefill (best-effort contract)."""
+    sched = ChaosSchedule(SEEDS[0])
+    engine, _, recv = make_disagg(sched)
+    await recv.start()
+    try:
+        sched.fail_queue("size", times=-1)
+        out = await run_one(engine)
+        assert out["remote"] is False
+        assert engine.queue_probe_failures == 1
+        # The failed probe never reached _remote_prefill: no fallback tick.
+        assert engine.local_fallbacks == 0
+    finally:
+        await recv.close()
+
+
+# ------------------------------------------------------------------ deadlines
+async def test_expired_deadline_stops_at_router_before_dispatch():
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    calls: list = []
+    _, a, b, client = await serve_two_workers(drt, calls)
+    router = fast_router(client)
+    ctx = AsyncEngineContext()
+    ctx.start_timeout(0.0)
+    with pytest.raises(DeadlineExceededError, match="router"):
+        await router.generate({}, ctx)
+    assert calls == [] and sched.injected == []
+    await drt.close()
+
+
+async def test_expired_deadline_refused_by_request_plane():
+    """Bypassing the router's check, the plane itself refuses in-band."""
+    drt = DistributedRuntime.detached()
+    calls: list = []
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    await ep.serve_endpoint(make_worker("a", calls))
+    client = await ep.client()
+    await client.wait_for_instances(1, timeout=2)
+    ctx = AsyncEngineContext()
+    ctx.deadline = time.time() - 1
+    frames = await client.generate_to(client.instances[0], {}, ctx)
+    with pytest.raises(EngineError, match="deadline exceeded"):
+        await collect(frames)
+    assert calls == []
+    await drt.close()
+
+
+async def test_expired_deadline_refused_by_tcp_plane():
+    """Over real TCP the remaining budget rides the request header and
+    the server refuses before invoking the handler."""
+    from dynamo_exp_tpu.runtime.transports.tcp import TcpRequestPlane
+    from dynamo_exp_tpu.runtime.transports.base import EndpointAddress, InstanceInfo
+
+    plane = TcpRequestPlane()
+    calls: list = []
+    info = InstanceInfo(
+        address=EndpointAddress("ft", "worker", "generate"), instance_id=77
+    )
+    served = await plane.serve(info, make_worker("a", calls))
+    client = Client.new_static(plane, [info])
+    try:
+        ctx = AsyncEngineContext()
+        ctx.deadline = time.time()  # zero remaining budget
+        frames = await client.generate_to(info, {}, ctx)
+        with pytest.raises(EngineError, match="deadline exceeded"):
+            await collect(frames)
+        assert calls == []
+        # Sanity: an unexpired context on the same plane flows normally.
+        ok = await client.generate_to(info, {}, AsyncEngineContext())
+        assert len(await collect(ok)) == 3
+    finally:
+        await served.close()
+        await plane.close()
+
+
+async def test_expired_deadline_cancels_queued_prefill_before_transfer():
+    """Acceptance: the prefill worker drops an expired queue item without
+    prefill compute or KV transfer."""
+    from dynamo_exp_tpu.disagg import PrefillWorker, RemotePrefillRequest
+
+    class NeverPrefillEngine:
+        prefill_calls = 0
+
+        async def prefill_extract(self, binput):
+            NeverPrefillEngine.prefill_calls += 1
+            raise AssertionError("expired request must not be prefilled")
+
+    queue = InProcWorkQueue()
+    worker = PrefillWorker(NeverPrefillEngine(), queue)
+    req = RemotePrefillRequest(
+        request_id="expired-1",
+        token_ids=[1, 2, 3],
+        return_addr="127.0.0.1:1",  # nothing listens: a send would fail loudly
+        deadline_unix=time.time() - 0.5,
+    )
+    await worker._serve_one(req.to_bytes())
+    assert worker.expired == 1
+    assert worker.served == 0 and worker.failed == 0
+    assert NeverPrefillEngine.prefill_calls == 0
+
+    # A live deadline still gets served (engine raising marks it failed,
+    # proving the worker got past the deadline gate).
+    live = RemotePrefillRequest(
+        request_id="live-1",
+        token_ids=[1, 2, 3],
+        return_addr="127.0.0.1:1",
+        deadline_unix=time.time() + 60,
+    )
+    await worker._serve_one(live.to_bytes())
+    assert NeverPrefillEngine.prefill_calls == 1
+    assert worker.failed == 1 and worker.expired == 1
+
+
+# -------------------------------------------------------------- graceful drain
+async def test_drain_removes_instance_with_zero_failed_inflight():
+    """Acceptance: drain intent (the ``llmctl drain`` KV key) flips the
+    instance to draining, routers stop sending it new work, and the
+    in-flight stream finishes cleanly."""
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    calls: list = []
+    _, a, b, client = await serve_two_workers(drt, calls, step_delay_s=0.02)
+    router = fast_router(client)
+
+    # Round-robin: first request lands on a and streams slowly.
+    inflight = asyncio.ensure_future(collect(await router.generate({})))
+    await asyncio.sleep(0.01)
+    assert calls == ["a"]
+
+    # Operator drains a (the exact write `llmctl drain <id>` performs).
+    await drt.discovery.kv_put(f"{DRAIN_PREFIX}{a.instance_id}", b"1")
+    for _ in range(200):
+        live = {i.instance_id: i for i in client.instances}
+        if live.get(a.instance_id) and live[a.instance_id].metadata.get("draining"):
+            break
+        await asyncio.sleep(0.005)
+    else:
+        pytest.fail("drain metadata never reached the client")
+    assert a.is_draining
+
+    # New work only reaches b.
+    for _ in range(4):
+        out = await collect(await router.generate({}))
+        assert {o["worker"] for o in out} == {"b"}
+
+    # The in-flight request on a finished untouched: zero failures.
+    out = await asyncio.wait_for(inflight, 5)
+    assert [o["tok"] for o in out] == [1, 2, 3]
+    assert {o["worker"] for o in out} == {"a"}
+
+    # close() completes the drain (deregister + wait for inflight=0).
+    await a.close()
+    for _ in range(200):
+        if all(i.instance_id != a.instance_id for i in client.instances):
+            break
+        await asyncio.sleep(0.005)
+    assert all(i.instance_id != a.instance_id for i in client.instances)
+    await drt.close()
+
+
+async def test_llmctl_drain_command_drives_worker_drain():
+    """The llmctl subcommand itself: validates liveness, writes the
+    drain key, and the worker's drain watcher picks it up."""
+    import argparse
+
+    from dynamo_exp_tpu.llmctl import drain_instance
+
+    drt = DistributedRuntime.detached()
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    a = await ep.serve_endpoint(make_worker("a", []))
+
+    # Unknown instance: refused, nothing written.
+    rc = await drain_instance(drt, argparse.Namespace(instance_id=999999))
+    assert rc == 1
+    assert await drt.discovery.kv_get(f"{DRAIN_PREFIX}999999") is None
+
+    rc = await drain_instance(drt, argparse.Namespace(instance_id=a.instance_id))
+    assert rc == 0
+    for _ in range(200):
+        if a.is_draining:
+            break
+        await asyncio.sleep(0.005)
+    assert a.is_draining
+    # The worker consumes its drain key — intents must not pile up.
+    for _ in range(200):
+        key = f"{DRAIN_PREFIX}{a.instance_id}"
+        if await drt.discovery.kv_get(key) is None:
+            break
+        await asyncio.sleep(0.005)
+    assert await drt.discovery.kv_get(f"{DRAIN_PREFIX}{a.instance_id}") is None
+    await drt.close()
+
+
+async def test_drained_singleton_yields_503_shaped_error():
+    """All instances draining → NoHealthyInstancesError (the HTTP 503 +
+    Retry-After path), not a confusing connection error."""
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    a = await ep.serve_endpoint(make_worker("a", []))
+    client = await ep.client()
+    await client.wait_for_instances(1, timeout=2)
+    router = fast_router(client)
+    await a.drain()
+    for _ in range(200):
+        if client.instances and client.instances[0].metadata.get("draining"):
+            break
+        await asyncio.sleep(0.005)
+    with pytest.raises(NoHealthyInstancesError):
+        await router.generate({})
+    await drt.close()
+
+
+# -------------------------------------------------------------- discovery flap
+async def test_client_watch_resubscribes_after_discovery_flap():
+    """Satellite: a dying watch stream must not freeze the client's
+    membership view — it logs, re-subscribes, and re-lists."""
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    await ep.serve_endpoint(
+        make_worker("a", []), lease=await drt.discovery.create_lease()
+    )
+    client = await ep.client()
+    await client.wait_for_instances(1, timeout=2)
+
+    # Break the next two watch pushes; registrations during the gap are
+    # only recoverable via the on-resume re-list.
+    sched.fail_watch(times=2)
+    await ep.serve_endpoint(
+        make_worker("b", []), lease=await drt.discovery.create_lease()
+    )
+    await client.wait_for_instances(2, timeout=5)
+    assert len(client.instances) == 2
+
+    # The repaired watch keeps tracking future changes too.
+    await ep.serve_endpoint(
+        make_worker("c", []), lease=await drt.discovery.create_lease()
+    )
+    await client.wait_for_instances(3, timeout=5)
+    assert len(client.instances) == 3
+    await drt.close()
+
+
+# --------------------------------------------------------------- determinism
+async def _failover_scenario(seed: int):
+    """A fixed chaotic workload; returns (results, normalized fault log)."""
+    sched = ChaosSchedule(seed)
+    drt = chaos_runtime(sched)
+    calls: list = []
+    _, a, b, client = await serve_two_workers(drt, calls)
+    router = fast_router(client, seed)
+    sched.fail_requests(instance_id=a.instance_id, times=1)
+    sched.delay_requests(0.002, times=2)
+    results = []
+    for _ in range(4):
+        out = await collect(await router.generate({}))
+        results.append([o["worker"] for o in out])
+    # Instance ids are globally monotonic across runs; normalize by
+    # order of appearance so two runs are comparable.
+    ids = {}
+    norm = []
+    for entry in sched.injected:
+        op, iid, kind = entry.split(":")
+        norm.append((op, ids.setdefault(iid, len(ids)), kind))
+    await drt.close()
+    return results, norm, list(calls)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+async def test_chaos_schedule_is_deterministic_across_runs(seed):
+    """Acceptance: same seed + same script + same workload → identical
+    results and identical injected-fault sequence, run twice."""
+    first = await _failover_scenario(seed)
+    second = await _failover_scenario(seed)
+    assert first == second

@@ -92,6 +92,130 @@ async def test_invalid_body_400():
 
 
 @pytest.mark.asyncio
+async def test_no_instances_maps_to_503_with_retry_after():
+    """NoInstancesError (empty fleet) → 503 + Retry-After, unary path."""
+    from dynamo_exp_tpu.runtime import Client, PushRouter
+    from dynamo_exp_tpu.runtime.transports.inproc import InProcRequestPlane
+
+    svc = HttpService()
+    # A real router over a static client with zero instances.
+    router = PushRouter(Client.new_static(InProcRequestPlane(), []))
+    svc.manager.add_chat_model("echo", router)
+    client = await make_client(svc)
+    r = await client.post("/v1/chat/completions", json=chat_body(stream=False))
+    assert r.status == 503
+    assert r.headers["Retry-After"] == "1"
+    assert (await r.json())["error"]["type"] == "service_unavailable"
+    await client.close()
+
+
+@pytest.mark.asyncio
+async def test_breaker_open_maps_to_503_with_retry_after():
+    """NoHealthyInstancesError (instances exist, all breaker-open or
+    draining) takes the same 503 path."""
+    from dynamo_exp_tpu.runtime import NoHealthyInstancesError
+
+    class AllUnhealthyEngine:
+        async def generate(self, request, context=None):
+            raise NoHealthyInstancesError("all 2 instances unhealthy")
+
+    svc = HttpService()
+    svc.manager.add_chat_model("echo", AllUnhealthyEngine())
+    client = await make_client(svc)
+    r = await client.post("/v1/chat/completions", json=chat_body(stream=False))
+    assert r.status == 503
+    assert r.headers["Retry-After"] == "1"
+    assert "unhealthy" in (await r.json())["error"]["message"]
+    await client.close()
+
+
+@pytest.mark.asyncio
+async def test_engine_error_mid_stream_emits_sse_error_frame():
+    """EngineError once streaming has begun → in-band SSE error frame +
+    clean stream termination (no [DONE], no broken connection)."""
+    from dynamo_exp_tpu.runtime import (
+        AsyncEngineContext,
+        EngineError,
+        ResponseStream,
+    )
+
+    class MidStreamFailEngine:
+        async def generate(self, request, context=None):
+            ctx = context or AsyncEngineContext()
+
+            async def _gen():
+                yield {
+                    "id": "x",
+                    "object": "chat.completion.chunk",
+                    "created": 1,
+                    "model": "echo",
+                    "choices": [
+                        {"index": 0, "delta": {"content": "partial"}}
+                    ],
+                }
+                raise EngineError("worker died mid-stream")
+
+            return ResponseStream(_gen(), ctx)
+
+    svc = HttpService()
+    svc.manager.add_chat_model("echo", MidStreamFailEngine())
+    client = await make_client(svc)
+    r = await client.post("/v1/chat/completions", json=chat_body(stream=True))
+    assert r.status == 200  # headers were already sent when the error hit
+    raw = (await r.read()).decode()  # reading to EOF: terminated cleanly
+    events = [line for line in raw.split("\n") if line.startswith("event: ")]
+    assert "event: error" in events
+    assert "worker died mid-stream" in raw
+    assert "data: [DONE]" not in raw  # an errored stream must not claim success
+    assert "partial" in raw  # the pre-error output was delivered
+    await client.close()
+
+
+@pytest.mark.asyncio
+async def test_request_timeout_arms_deadline_and_maps_to_504():
+    """``timeout_s`` (body) arms the per-request deadline on the engine
+    context; a deadline-exceeded request maps to 504."""
+    from dynamo_exp_tpu.runtime import AsyncEngineContext, DeadlineExceededError
+
+    seen: dict = {}
+
+    import asyncio
+
+    class DeadlineEngine:
+        async def generate(self, request, context=None):
+            ctx = context or AsyncEngineContext()
+            seen["remaining"] = ctx.time_remaining()
+            # Simulate work outlasting the budget, then hit the gate the
+            # router/remote stages apply.
+            while not ctx.deadline_expired:
+                await asyncio.sleep(0.001)
+            ctx.check_deadline("router")
+            raise AssertionError("unreachable: deadline already expired")
+
+    svc = HttpService()
+    svc.manager.add_chat_model("echo", DeadlineEngine())
+    client = await make_client(svc)
+    body = {**chat_body(stream=False), "timeout_s": 0.005}
+    r = await client.post("/v1/chat/completions", json=body)
+    assert r.status == 504
+    assert (await r.json())["error"]["type"] == "deadline_exceeded"
+    assert seen["remaining"] is not None  # the context carried a deadline
+    # The header variant arms it too.
+    r = await client.post(
+        "/v1/chat/completions",
+        json=chat_body(stream=False),
+        headers={"X-Request-Timeout-S": "0.005"},
+    )
+    assert r.status == 504
+    # Invalid budget is a 400, not a silent no-deadline.
+    r = await client.post(
+        "/v1/chat/completions", json={**chat_body(stream=False), "timeout_s": -5}
+    )
+    assert r.status == 400
+    await client.close()
+
+
+@pytest.mark.asyncio
 async def test_metrics_exposed_after_requests():
     svc = HttpService()
     svc.manager.add_chat_model("echo", EchoEngineFull())
