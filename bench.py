@@ -148,6 +148,96 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
     }
 
 
+def run_occupancy_sweep(
+    slots: int = 8, isl: int = 512, osl: int = 128
+) -> list[dict]:
+    """Decode throughput vs *occupancy* on a fixed-slot engine.
+
+    The compiled decode window is row-compacted (docs/engine_perf.md):
+    at 1 active sequence of ``slots`` slots the engine should pick the
+    rows=1 variant and pay ~1/slots of the full-batch FLOPs/HBM — this
+    sweep captures that curve plus the compiled-variant counts and
+    wasted-step counters, so BENCH_r* records regressions where decode
+    cost snaps back to the worst case."""
+    import asyncio
+
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models import PRESETS
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    _enable_compile_cache()
+    mcfg = PRESETS[MODEL]
+    cfg = EngineConfig(
+        model=mcfg,
+        max_decode_slots=slots,
+        page_size=16,
+        num_pages=slots * ((isl + osl) // 16 + 2) + 64,
+        max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
+        eos_token_ids=[],
+        decode_window=32,
+    )
+    engine = TPUEngine(cfg, seed=0)
+    engine.start()
+    rs = np.random.RandomState(0)
+
+    async def run_one(prompt):
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = osl
+        b.stop_conditions.ignore_eos = True
+        stream = await engine.generate(b.to_dict())
+        n = 0
+        async for item in stream:
+            n += len(item.get("token_ids", []))
+        return n
+
+    def prompts(n):
+        return [
+            rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
+            for _ in range(n)
+        ]
+
+    async def point(active: int) -> float:
+        # Double warmup per occupancy (compile + program load), then
+        # best-of-three timed bursts (same policy as run_point).
+        for _ in range(2):
+            await asyncio.gather(*[run_one(p) for p in prompts(active)])
+        best = 0.0
+        for _ in range(3):
+            batch = prompts(active)
+            t0 = time.perf_counter()
+            results = await asyncio.gather(*[run_one(p) for p in batch])
+            dt = time.perf_counter() - t0
+            best = max(best, sum(results) / dt)
+        return best
+
+    out = []
+    occupancies = sorted({1, 2, 4, slots})
+    for active in occupancies:
+        wasted0 = engine.wasted_steps
+        moves0 = engine.kv_page_moves
+        tok_s = asyncio.run(point(active))
+        m = engine.metrics()
+        out.append(
+            {
+                "metric": f"decode_occupancy_{MODEL}_isl{isl}_osl{osl}"
+                f"_a{active}of{slots}",
+                "value": round(tok_s, 1),
+                "unit": "tok/s",
+                "vs_baseline": round(
+                    tok_s / _roofline_tok_s(engine.params, active), 4
+                ),
+                "active": active,
+                "slots": slots,
+                "compiled_decode_variants": m["compiled_decode_variants"],
+                "compiled_prefill_variants": m["compiled_prefill_variants"],
+                "wasted_steps": engine.wasted_steps - wasted0,
+                "kv_page_moves": engine.kv_page_moves - moves0,
+            }
+        )
+    engine.stop()
+    return out
+
+
 def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> dict:
     """TTFT with a warm shared prefix vs cold prompts.
 
@@ -263,6 +353,12 @@ def main() -> None:
         action="store_true",
         help="warm-prefix vs cold TTFT (the KV-reuse headline claim)",
     )
+    ap.add_argument(
+        "--occupancy-sweep",
+        action="store_true",
+        help="tok/s at 1/2/4/8 active sequences of 8 slots (compacted "
+        "decode proportionality curve)",
+    )
     ap.add_argument("--model", default=MODEL, help="preset name")
     args = ap.parse_args()
     MODEL = args.model
@@ -270,6 +366,9 @@ def main() -> None:
     if args.sweep:
         for c in SWEEP_CONCURRENCY:
             print(json.dumps(run_point(SWEEP_ISL, SWEEP_OSL, c)), flush=True)
+    elif args.occupancy_sweep:
+        for point in run_occupancy_sweep():
+            print(json.dumps(point), flush=True)
     elif args.prefix_reuse:
         print(json.dumps(run_prefix_reuse()))
     else:

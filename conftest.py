@@ -58,6 +58,7 @@ _NIGHTLY_FILES = {
     "test_paged_decode.py",  # Pallas interpret-mode vs XLA oracle
     "test_logprobs.py",  # engine logprob oracle runs
     "test_disagg.py",  # two-engine disagg e2e
+    "test_decode_compaction.py",  # occupancy-proportional decode proofs
     "test_ring_attention.py",  # ring vs dense oracles on the 8-dev mesh
     "test_kv_offload.py",  # host-offload round trips
     "test_model.py",  # full-model forward oracles

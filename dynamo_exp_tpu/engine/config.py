@@ -51,6 +51,20 @@ class EngineConfig:
     # stop-condition latency (a sequence may overshoot its stop by up
     # to window-1 discarded tokens).
     decode_window: int = 8
+    # One compiled decode window per (dispatched) row bucket keeps decode
+    # cost proportional to occupancy instead of max_decode_slots; rows
+    # are compacted into the smallest 1/2/4/... bucket that fits the
+    # ACTIVE set (see docs/engine_perf.md).
+    # Static width of the per-row on-device stop-token set fed into the
+    # decode window (EOS + request stop ids, -1 padded). Keeping it
+    # static keeps it out of the compile key; requests with more stop
+    # ids than this fall back to host-side stopping for the overflow.
+    device_stop_width: int = 8
+    # Keep one decode window in flight: dispatch window N+1 straight
+    # from window N's on-device carry (tokens/positions) while the host
+    # is still consuming window N's sampled tokens. Disable to force the
+    # dispatch -> sync -> consume lockstep (debugging/equivalence runs).
+    chained_decode: bool = True
     # Sampling defaults when the request leaves them unset.
     default_max_tokens: int = 256
     eos_token_ids: list[int] = field(default_factory=list)
@@ -92,15 +106,33 @@ class EngineConfig:
         """Static page-count bucket for the XLA attention gather: next
         power of two >= n_pages (min 4), capped at max_pages_per_seq.
         Bounds the compile-variant count to O(log Pmax)."""
-        cap = self.max_pages_per_seq
-        b = 4
-        while b < n_pages:
+        return self._pow2_bucket(n_pages, 4, self.max_pages_per_seq)
+
+    @staticmethod
+    def _pow2_bucket(n: int, floor: int, cap: int | None = None) -> int:
+        """Next power of two >= n, starting at ``floor``, optionally
+        capped — the one bucketing policy every static-shape family
+        (prefill rows, decode rows, page moves, attention pages) uses,
+        bounding compiled-variant counts to O(log)."""
+        b = floor
+        while b < n:
             b *= 2
-        return min(b, cap)
+        return b if cap is None else min(b, cap)
 
     def rows_bucket_for(self, n: int) -> int:
         """Prefill-batch row bucket (1/2/4/.../prefill_batch)."""
-        b = 1
-        while b < n:
-            b *= 2
-        return min(b, self.prefill_batch)
+        return self._pow2_bucket(n, 1, self.prefill_batch)
+
+    def decode_rows_bucket_for(self, n: int) -> int:
+        """Decode-batch row bucket (1/2/4/.../max_decode_slots): the
+        compiled decode window computes only this many rows, so decode
+        FLOPs and HBM traffic track true occupancy, not the slot
+        envelope."""
+        return self._pow2_bucket(n, 1, self.max_decode_slots)
+
+    def page_move_bucket_for(self, n: int) -> int:
+        """Static page-count bucket for batched KV page gather/scatter
+        (disagg extract/inject, G2 re-uploads, eviction offload bursts):
+        next power of two >= n, min 8. One compiled variant per bucket
+        moves a whole sequence's pages in one dispatch."""
+        return self._pow2_bucket(n, 8)

@@ -6,16 +6,26 @@ JAX engine:
 
 - **Two small families of compiled programs** drive everything: decode
   *windows* (``lax.scan`` over ``decode_window`` steps with sampled
-  tokens fed back on-device, keyed by attention impl / page bucket /
-  sampler variant — one host sync per window, which is what survives a
-  high-latency host↔device link) and batched chunked prefill (keyed by
-  row bucket × token bucket × page bucket). Static shapes, no
-  recompiles in steady state; KV pools are donated so XLA updates them
-  in place in HBM.
+  tokens fed back on-device, keyed by row bucket / attention impl /
+  page bucket / sampler variant — one host sync per window, which is
+  what survives a high-latency host↔device link) and batched chunked
+  prefill (keyed by row bucket × token bucket × page bucket). Static
+  shapes, no recompiles in steady state; KV pools are donated so XLA
+  updates them in place in HBM.
+- **Decode cost tracks occupancy, not the slot envelope**
+  (docs/engine_perf.md): ACTIVE rows are compacted into the smallest
+  row bucket and partitioned greedy-vs-sampler; stop detection (EOS /
+  stop ids / budget) runs on-device inside the window so finished rows
+  park at position -1 instead of writing garbage KV; KV pages move in
+  batched multi-page gathers/scatters (one dispatch per sequence or
+  eviction burst); and in steady state the next window launches from
+  the previous window's device carry before the host syncs, so emit
+  processing overlaps device compute.
 - **The host loop is the scheduler** (reference's "hard part #3",
   SURVEY.md §7): stop flags, admissions, page allocation, and KV event
   emission all happen between steps on the loop thread — never inside a
-  compiled region.
+  compiled region. The host's ``check_stop`` stays authoritative; the
+  on-device stop is an optimization, not the source of truth.
 - **Prefix caching is free at the attention level**: reused pages are
   already resident; prefill just starts its positions after the cached
   prefix (write-then-gather attention reads them like any other page).
@@ -35,6 +45,7 @@ import logging
 import queue
 import threading
 import time
+from dataclasses import dataclass
 from functools import partial
 from typing import AsyncIterator, Callable
 
@@ -53,7 +64,12 @@ from ..models.llama import (
     kv_cache_shardings,
     param_shardings,
 )
-from ..ops.sampling import apply_penalties, sample_tokens, token_logprobs
+from ..ops.sampling import (
+    apply_penalties,
+    sample_tokens,
+    stop_token_hit,
+    token_logprobs,
+)
 from ..parallel.mesh import build_mesh
 from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
@@ -64,6 +80,46 @@ from .offload import CopyStream, HostKvPool
 from .scheduler import RemoteKv, Scheduler, SeqState, Sequence
 
 log = logging.getLogger(__name__)
+
+
+@dataclass
+class _PendingDecode:
+    """One dispatched decode window the host has not yet consumed.
+
+    Holds the device-side results (``ys``) plus the final scan carry
+    (``tokens_dev``/``positions_dev``) — the exact inputs of the next
+    window over the same rows, so a chained dispatch can launch window
+    N+1 straight from device state while the host still owns window N's
+    sync (see ``TPUEngine._dispatch_chained``)."""
+
+    ys: tuple  # [K, rows] sampled tokens (+ logprob arrays when want_lp)
+    tokens_dev: object  # final carry: next window's input tokens [rows]
+    positions_dev: object  # final carry: next window's positions [rows]
+    stepped: list  # [(Sequence, n_valid, row)]
+    rows: int  # row bucket (array batch dim)
+    full_sampler: bool
+    want_lp: bool
+    solo: bool  # only decode dispatch of its iteration -> chainable
+    # True when some row could hit its page/model-length cap inside this
+    # window (cap < wpos + K at dispatch). Its device carry position
+    # flips to -1 at the cap, but the host RESUMES such a row after
+    # allocating pages rather than finishing it — so a chained window
+    # would feed the dead carry and emit garbage. Chaining requires this
+    # to be False; stop/budget deaths are safe (the host finishes those
+    # rows at consume and skips them in the successor).
+    capacity_capped: bool
+    stop_tokens: object  # np [rows, S], reused verbatim by a chain
+    sampler_args: tuple | None = None  # (temp, top_k, top_p, f, p, r) np
+    slot_map: object | None = None  # np [rows] (sampler variants only)
+
+
+@dataclass
+class _PendingPrefill:
+    """One dispatched prefill chunk awaiting its host sync."""
+
+    ys: tuple
+    completed: list  # [(row, Sequence)] rows whose prompt finished
+    want_lp: bool
 
 
 class TPUEngine(AsyncEngine):
@@ -117,11 +173,13 @@ class TPUEngine(AsyncEngine):
             # The CopyStream (a live thread) is created by start(), so a
             # constructed-but-never-started engine owns no threads.
             def on_evict(pid: int, seq_hash: int) -> None:
-                # Dispatch the on-device gather now (stream order protects
-                # it from the next donated forward); the CopyStream thread
-                # blocks on the transfer and commits into the host pool.
-                k_pg, v_pg = self._gather_page(self.k_cache, self.v_cache, pid)
-                self.copy_stream.offload(seq_hash, k_pg, v_pg)
+                # Coalesce: eviction bursts (a big allocation reclaiming
+                # many parked pages) buffer here and flush as ONE batched
+                # gather right before the next compute dispatch — stream
+                # order still protects the pages from the forward that
+                # overwrites them, but the burst costs one dispatch + one
+                # host sync instead of one per page.
+                self._pending_offloads.append((pid, seq_hash))
 
         self.kv = KvPageManager(
             cfg.num_pages,
@@ -132,25 +190,38 @@ class TPUEngine(AsyncEngine):
         )
         self.sched = Scheduler(cfg, self.kv)
 
-        # Per-page movement kernels, shared by the G2 offload tier and
+        # Multi-page movement kernels, shared by the G2 offload tier and
         # the disaggregation KV handoff (gather → wire / wire → inject).
-        self._gather_page = jax.jit(lambda k, v, pid: (k[:, pid], v[:, pid]))
-        self._inject_page = jax.jit(
-            lambda k, v, pid, hk, hv: (
-                k.at[:, pid].set(hk),
-                v.at[:, pid].set(hv),
+        # ``pids`` is a page_move_bucket_for-padded [n] vector, so a whole
+        # sequence (or eviction burst) moves in ONE dispatch; jit's own
+        # cache keys the O(log Pmax) bucket shapes. Scatter pads repeat
+        # the last (pid, page) pair — duplicate indices with identical
+        # updates are deterministic.
+        self._gather_pages = jax.jit(
+            lambda k, v, pids: (k[:, pids], v[:, pids])
+        )
+        self._inject_pages = jax.jit(
+            lambda k, v, pids, hk, hv: (
+                k.at[:, pids].set(hk),
+                v.at[:, pids].set(hv),
             ),
             donate_argnums=(0, 1),
         )
+        # Evictions buffered by on_evict until the next compute dispatch.
+        self._pending_offloads: list[tuple[int, int]] = []
 
         B, V = cfg.max_decode_slots, mcfg.vocab_size
-        self._counts = jnp.zeros((B, V), jnp.int32)  # penalty bookkeeping
+        # Penalty bookkeeping, indexed by slot. Row B is a scratch row:
+        # compacted decode windows gather counts through a slot map whose
+        # padding rows point here, so pad scatters never touch a live
+        # slot's counts.
+        self._counts = jnp.zeros((B + 1, V), jnp.int32)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._attn_impl, self._attn_interpret = self._resolve_attn()
         # Compiled-variant caches. Decode windows are keyed by
-        # (attention impl, static page bound — None on the Pallas path,
-        # which reads true lengths — and full-vs-greedy sampler);
-        # prefill by (row bucket, token bucket, page bound).
+        # (row bucket, attention impl, static page bound — None on the
+        # Pallas path, which reads true lengths — full-vs-greedy sampler,
+        # and want_lp); prefill by (row bucket, token bucket, page bound).
         self._decode_fns: dict[tuple, Callable] = {}
         self._prefill_fns: dict[tuple[int, int, int], Callable] = {}
         # Fresh penalty row for a slot: zero it, then count the first
@@ -166,6 +237,13 @@ class TPUEngine(AsyncEngine):
         self._thread: threading.Thread | None = None
         self.steps = 0  # decode step counter (metrics)
         self._last_gauge_pub = 0.0  # telemetry gauge throttle
+        # Chained decode: the dispatched-but-unconsumed window (if any).
+        self._inflight: _PendingDecode | None = None
+        # Occupancy/movement counters (mirrored to /metrics counters and
+        # surfaced by metrics() for bench.py's occupancy sweep).
+        self.wasted_steps = 0  # window steps computed past a row's stop
+        self.kv_page_moves = 0  # pages moved by batched gather/scatter
+        self.kv_move_dispatches = 0  # batched-move dispatches issued
 
     # ----------------------------------------------------------- compiled fns
     def _resolve_attn(self) -> tuple[str, bool]:
@@ -217,22 +295,43 @@ class TPUEngine(AsyncEngine):
         return impl, interpret
 
     def _decode_fn(
-        self, attn_pages: int | None, full_sampler: bool, want_lp: bool
+        self,
+        rows: int,
+        attn_pages: int | None,
+        full_sampler: bool,
+        want_lp: bool,
     ):
         """One compiled decode *window*: ``decode_window`` steps run
         on-device under ``lax.scan`` with sampled tokens fed straight
         back — the host syncs once per window instead of once per token,
         which is what makes decode throughput survive a high-latency
-        host↔device link. ``full_sampler=False`` is the greedy fast
-        path (no penalties, no top-k/p machinery) used whenever every
-        stepped row is greedy.
+        host↔device link.
+
+        ``rows`` is the compacted batch dim (decode_rows_bucket_for of
+        the ACTIVE row count), NOT max_decode_slots: at occupancy 1 the
+        window computes 1 row, so decode FLOPs and HBM traffic track
+        true load. ``full_sampler=False`` is the greedy fast path (no
+        penalties, no top-k/p machinery, no RNG, no counts traffic)
+        used for the greedy partition of the batch — one creative
+        request no longer drags every greedy row through the sampler.
+
+        Stop detection runs on-device: each row carries a padded stop
+        set plus EOS/budget step gates, and a row that stops flips its
+        position to -1 mid-window — no garbage KV writes, no page
+        overrun past EOS — which makes large ``decode_window`` values
+        profitable instead of a tail-latency tax. The host's check_stop
+        stays authoritative for everything it can see.
+
+        The final scan carry (tokens, positions) is returned so the next
+        window over the same rows can be dispatched device-to-device
+        (chained) before the host syncs on this one.
 
         Even when the Pallas kernel is available, short contexts take
         the XLA gather: below ~1k tokens of page bucket the gather's
         HBM traffic is trivial and the kernel's serial per-row DMA grid
         costs more than it saves. The kernel wins where it matters —
-        long contexts, where gather traffic scales with B*bucket while
-        the kernel's scales with the true total context."""
+        long contexts, where gather traffic scales with rows*bucket
+        while the kernel's scales with the true total context."""
         impl, interpret, mesh = self._attn_impl, self._attn_interpret, self.mesh
         if (
             impl == "pallas"
@@ -241,65 +340,118 @@ class TPUEngine(AsyncEngine):
         ):
             impl = "xla"
         pages = None if impl == "pallas" else attn_pages
-        key = (impl, pages, full_sampler, want_lp)
+        key = (rows, impl, pages, full_sampler, want_lp)
         fn = self._decode_fns.get(key)
         if fn is not None:
             return fn
         mcfg = self.cfg.model
         K = self.cfg.decode_window
 
-        @partial(jax.jit, donate_argnums=(1, 2, 8))
-        def decode_window(params, k, v, tokens, positions, max_pos, page_table,
-                          rng, counts, temp, top_k, top_p, freq_pen, pres_pen,
-                          rep_pen):
-            def step(carry, _):
-                tokens, positions, k, v, rng, counts = carry
-                logits, k, v = forward(
-                    params, mcfg, tokens[:, None], positions[:, None],
-                    page_table, k, v, attn_pages=pages, attn_impl=impl,
-                    mesh=mesh, interpret=interpret,
-                )
-                logits = logits[:, 0]  # [B, V]
-                if full_sampler:
+        def run_forward(params, tokens, positions, page_table, k, v):
+            logits, k, v = forward(
+                params, mcfg, tokens[:, None], positions[:, None],
+                page_table, k, v, attn_pages=pages, attn_impl=impl,
+                mesh=mesh, interpret=interpret,
+            )
+            return logits[:, 0], k, v  # [rows, V]
+
+        def advance(positions, max_pos, next_tok, stop_set, eos_gate,
+                    budget_gate, t, active):
+            # A row leaves the window (position -1, writes dropped) when
+            # it hits its page/model-length capacity, samples a token
+            # from its stop set past its min-tokens gate, or exhausts
+            # its remaining max_tokens budget.
+            done = (
+                stop_token_hit(next_tok, stop_set) & (t >= eos_gate)
+            ) | (t >= budget_gate)
+            return jnp.where(
+                active & ~done & (positions < max_pos), positions + 1, -1
+            )
+
+        if full_sampler:
+
+            @partial(jax.jit, donate_argnums=(1, 2, 8))
+            def decode_window(params, k, v, tokens, positions, max_pos,
+                              page_table, rng, counts_all, slot_map, temp,
+                              top_k, top_p, freq_pen, pres_pen, rep_pen,
+                              stop_set, eos_gate, budget_gate):
+                # Compaction: penalty rows live slot-indexed in the
+                # [B+1, V] pool; gather the stepped rows in, scatter
+                # back out (pad rows map to the scratch row B).
+                counts0 = counts_all[slot_map]
+
+                def step(carry, t):
+                    tokens, positions, k, v, rng, counts = carry
+                    logits, k, v = run_forward(
+                        params, tokens, positions, page_table, k, v
+                    )
                     shaped = apply_penalties(
                         logits, counts, freq_pen, pres_pen, rep_pen
                     )
                     rng2, sub = jax.random.split(rng)
                     next_tok = sample_tokens(shaped, sub, temp, top_k, top_p)
-                else:
-                    rng2 = rng
-                    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                # OpenAI logprobs: of the MODEL distribution (raw
-                # logits, pre-penalty/temperature), chosen + top-k.
-                # Compiled only into the want_lp variant — the common
-                # no-logprobs workload pays neither the full-vocab
-                # log_softmax nor the extra per-window host transfer.
-                if want_lp:
-                    lp, top_ids, top_lp = token_logprobs(logits, next_tok)
-                active = positions >= 0
-                counts = counts.at[
-                    jnp.arange(counts.shape[0]), next_tok
-                ].add(active.astype(jnp.int32))
-                # Feed the sampled token back; a row leaves the window
-                # (position -1, writes dropped) once it hits its page /
-                # model-length capacity.
-                tokens = jnp.where(active, next_tok, tokens)
-                positions = jnp.where(
-                    active & (positions < max_pos), positions + 1, -1
-                )
-                ys = (
-                    (next_tok, lp, top_ids, top_lp)
-                    if want_lp
-                    else (next_tok,)
-                )
-                return (tokens, positions, k, v, rng2, counts), ys
+                    # OpenAI logprobs: of the MODEL distribution (raw
+                    # logits, pre-penalty/temperature), chosen + top-k.
+                    # Compiled only into the want_lp variant — the common
+                    # no-logprobs workload pays neither the full-vocab
+                    # log_softmax nor the extra per-window host transfer.
+                    if want_lp:
+                        lp, top_ids, top_lp = token_logprobs(logits, next_tok)
+                    active = positions >= 0
+                    counts = counts.at[
+                        jnp.arange(counts.shape[0]), next_tok
+                    ].add(active.astype(jnp.int32))
+                    tokens = jnp.where(active, next_tok, tokens)
+                    positions = advance(
+                        positions, max_pos, next_tok, stop_set, eos_gate,
+                        budget_gate, t, active,
+                    )
+                    ys = (
+                        (next_tok, lp, top_ids, top_lp)
+                        if want_lp
+                        else (next_tok,)
+                    )
+                    return (tokens, positions, k, v, rng2, counts), ys
 
-            (_, _, k, v, rng, counts), ys = jax.lax.scan(
-                step, (tokens, positions, k, v, rng, counts), None, length=K
-            )
-            # ys: toks [K,B] (+ lp [K,B], top_ids/top_lp [K,B,N] when
-            # want_lp).
-            return ys, k, v, rng, counts
+                (tokens, positions, k, v, rng, counts), ys = jax.lax.scan(
+                    step, (tokens, positions, k, v, rng, counts0),
+                    jnp.arange(K),
+                )
+                counts_all = counts_all.at[slot_map].set(counts)
+                # ys: toks [K,rows] (+ lp [K,rows], top_ids/top_lp
+                # [K,rows,N] when want_lp).
+                return ys, k, v, rng, counts_all, tokens, positions
+
+        else:
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def decode_window(params, k, v, tokens, positions, max_pos,
+                              page_table, stop_set, eos_gate, budget_gate):
+                def step(carry, t):
+                    tokens, positions, k, v = carry
+                    logits, k, v = run_forward(
+                        params, tokens, positions, page_table, k, v
+                    )
+                    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    if want_lp:
+                        lp, top_ids, top_lp = token_logprobs(logits, next_tok)
+                    active = positions >= 0
+                    tokens = jnp.where(active, next_tok, tokens)
+                    positions = advance(
+                        positions, max_pos, next_tok, stop_set, eos_gate,
+                        budget_gate, t, active,
+                    )
+                    ys = (
+                        (next_tok, lp, top_ids, top_lp)
+                        if want_lp
+                        else (next_tok,)
+                    )
+                    return (tokens, positions, k, v), ys
+
+                (tokens, positions, k, v), ys = jax.lax.scan(
+                    step, (tokens, positions, k, v), jnp.arange(K)
+                )
+                return ys, k, v, tokens, positions
 
         self._decode_fns[key] = decode_window
         return decode_window
@@ -350,7 +502,14 @@ class TPUEngine(AsyncEngine):
         if self._thread:
             self._thread.join(timeout=30)
             self._thread = None
+        self._inflight = None
         if self.copy_stream is not None:
+            # Flush evictions the dead loop buffered, then drain
+            # (bounded) so a graceful drain doesn't silently discard
+            # queued host-tier offloads — every committed page is a
+            # recompute the next instance of this prefix never pays.
+            self._flush_offloads()
+            self.copy_stream.drain()
             self.copy_stream.stop()
             self.copy_stream = None
 
@@ -472,16 +631,45 @@ class TPUEngine(AsyncEngine):
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
         """One iteration = admit everything admissible, dispatch at most
-        one batched prefill chunk, then one decode step — so decode
+        one batched prefill chunk, then one decode window — so decode
         interleaves between the chunks of long prompts instead of
         stalling behind them (scheduler v2 policy, ``scheduler.py``
-        module docstring)."""
+        module docstring).
+
+        The host pipelines against the device instead of blocking on
+        ``np.asarray`` right after each dispatch: a decode window is
+        left *in flight* and consumed one iteration later, and in steady
+        state (no arrivals, no prefill, single partition) window N+1 is
+        dispatched straight from window N's on-device carry BEFORE the
+        host syncs on window N — so emits, stop checks, page
+        registration, and admissions for window N overlap window N+1's
+        device time. All scheduler mutation that could free pages still
+        happens only when no unconsumed window could write to them."""
         try:
             while self._running:
+                if self._inflight is not None:
+                    # Steady state: launch the next window device-to-
+                    # device, then consume the previous one while the
+                    # new one executes.
+                    nxt = (
+                        self._dispatch_chained(self._inflight)
+                        if self._can_chain()
+                        else None
+                    )
+                    prev, self._inflight = self._inflight, nxt
+                    self._consume_decode(prev)
+                    self._maybe_publish_gauges()
+                    if self._inflight is not None:
+                        continue
+                    # Chain broken (arrivals / prefill / stop / dry
+                    # pool): fall through to the full scheduling path.
                 if not self.sched.has_work() and self._submit_q.empty():
-                    # Publish on the idle path too: the gauges must decay
+                    # Flush buffered evictions before idling (the host
+                    # tier must see them even with no next dispatch) and
+                    # publish on the idle path too: the gauges must decay
                     # to zero after the last request finishes, not freeze
                     # on the final busy-loop snapshot.
+                    self._flush_offloads()
                     self._maybe_publish_gauges()
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -505,14 +693,28 @@ class TPUEngine(AsyncEngine):
                     if seq.remote_kv is not None:
                         self._run_remote_inject(seq)
                         progressed = True
+                pending_prefill = None
                 if batch:
-                    self._run_prefill_chunk(batch[: self.cfg.prefill_batch])
+                    pending_prefill = self._dispatch_prefill_chunk(
+                        batch[: self.cfg.prefill_batch]
+                    )
                     progressed = True
-                if any(
-                    s is not None and s.state is SeqState.ACTIVE
-                    for s in self.sched.slots
+                # Decode dispatches BEFORE the prefill sync: the window
+                # executes behind the prefill on the device stream while
+                # the host consumes prefill completions.
+                pendings = self._dispatch_decode()
+                progressed = progressed or bool(pendings)
+                if pending_prefill is not None:
+                    self._consume_prefill(pending_prefill)
+                if (
+                    len(pendings) == 1
+                    and pendings[0].solo
+                    and self.cfg.chained_decode
                 ):
-                    progressed = self._run_decode() or progressed
+                    self._inflight = pendings[0]  # consumed next iteration
+                else:
+                    for p in pendings:
+                        self._consume_decode(p)
                 if not progressed:
                     # Pool dry / everything stalled: yield briefly.
                     self._wake.wait(timeout=0.001)
@@ -520,6 +722,7 @@ class TPUEngine(AsyncEngine):
         except Exception:  # engine death must not hang clients
             log.exception("engine loop crashed; failing in-flight requests")
             self._running = False
+            self._inflight = None
             self._fail_all()
             raise
 
@@ -573,15 +776,77 @@ class TPUEngine(AsyncEngine):
             except queue.Empty:
                 break
 
+    # ----------------------------------------------------- batched page moves
+    def _gather_page_batch(self, pids: list[int]):
+        """ONE compiled multi-page gather: device [L, bucket, ps, HkvD]
+        K/V pairs covering ``pids`` (bucket-padded with the last pid; the
+        caller slices back to len(pids)). One dispatch per call — a
+        3k-ISL extract moves ~190 pages here instead of 190 dispatches
+        and 190 host syncs."""
+        bucket = self.cfg.page_move_bucket_for(len(pids))
+        padded = np.full(bucket, pids[-1], np.int32)
+        padded[: len(pids)] = pids
+        k_b, v_b = self._gather_pages(
+            self.k_cache, self.v_cache, jnp.asarray(padded)
+        )
+        self.kv_move_dispatches += 1
+        self.kv_page_moves += len(pids)
+        return k_b, v_b
+
+    def _inject_page_batch(self, pids: list[int], k_pages, v_pages, op: str):
+        """ONE compiled multi-page scatter of host pages (list of
+        [L, ps, HkvD] numpy arrays) into device pages ``pids``. Pads by
+        repeating the last (pid, page) pair — duplicate scatter indices
+        with identical updates are deterministic. Buffered evictions
+        flush first so a page being overwritten was gathered for the
+        host tier before this scatter lands."""
+        self._flush_offloads()
+        bucket = self.cfg.page_move_bucket_for(len(pids))
+        pad = bucket - len(pids)
+        pid_arr = np.full(bucket, pids[-1], np.int32)
+        pid_arr[: len(pids)] = pids
+        hk = np.stack(list(k_pages) + [k_pages[-1]] * pad, axis=1)
+        hv = np.stack(list(v_pages) + [v_pages[-1]] * pad, axis=1)
+        self.k_cache, self.v_cache = self._inject_pages(
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(pid_arr),
+            jnp.asarray(hk),
+            jnp.asarray(hv),
+        )
+        self.kv_move_dispatches += 1
+        self.kv_page_moves += len(pids)
+        get_telemetry().kv_page_moves.labels(op).inc(len(pids))
+
+    def _flush_offloads(self) -> None:
+        """Batch-gather every eviction buffered since the last compute
+        dispatch and hand the burst to the CopyStream as one item.
+        Called right before anything that could overwrite the evicted
+        pages (decode/prefill/inject dispatches) and on the idle path —
+        stream order then guarantees the gather reads the old content."""
+        if not self._pending_offloads:
+            return
+        moved, self._pending_offloads = self._pending_offloads, []
+        if self.copy_stream is None:
+            return
+        k_b, v_b = self._gather_page_batch([pid for pid, _ in moved])
+        self.copy_stream.offload_batch([h for _, h in moved], k_b, v_b)
+        get_telemetry().kv_page_moves.labels("offload").inc(len(moved))
+
     # ---------------------------------------------------------------- prefill
     def _apply_uploads(self, seq: Sequence) -> None:
         """Re-inject G2 host pages into their fresh device pages before
         the compute that attends over them (dispatch order on the device
-        stream makes this safe without explicit sync)."""
-        for pid, _h, hk, hv in seq.pending_uploads:
-            self.k_cache, self.v_cache = self._inject_page(
-                self.k_cache, self.v_cache, pid, jnp.asarray(hk), jnp.asarray(hv)
-            )
+        stream makes this safe without explicit sync) — one batched
+        scatter per sequence, not one per page."""
+        if not seq.pending_uploads:
+            return
+        self._inject_page_batch(
+            [pid for pid, _h, _k, _v in seq.pending_uploads],
+            [hk for _pid, _h, hk, _v in seq.pending_uploads],
+            [hv for _pid, _h, _k, hv in seq.pending_uploads],
+            op="upload",
+        )
         seq.pending_uploads = []
 
     @staticmethod
@@ -638,44 +903,55 @@ class TPUEngine(AsyncEngine):
 
     def _extract_prompt_pages(self, seq: Sequence) -> list:
         """Host-bounce every prompt page (incl. the partial tail) for the
-        disaggregation handoff. Runs on the engine loop thread: the
-        prefill worker's job is exactly this transfer."""
+        disaggregation handoff: ONE batched gather dispatch and ONE host
+        sync per sequence. Runs on the engine loop thread: the prefill
+        worker's job is exactly this transfer."""
         ps = self.cfg.page_size
         n_pages = (len(seq.prompt) + ps - 1) // ps
-        pages = []
-        for pid in seq.page_ids[:n_pages]:
-            k_pg, v_pg = self._gather_page(self.k_cache, self.v_cache, pid)
-            pages.append((np.asarray(k_pg), np.asarray(v_pg)))
-        return pages
+        pids = seq.page_ids[:n_pages]
+        if not pids:
+            return []
+        k_b, v_b = self._gather_page_batch(pids)
+        k_np, v_np = np.asarray(k_b), np.asarray(v_b)  # the one sync
+        get_telemetry().kv_page_moves.labels("extract").inc(len(pids))
+        return [
+            (
+                np.ascontiguousarray(k_np[:, i]),
+                np.ascontiguousarray(v_np[:, i]),
+            )
+            for i in range(len(pids))
+        ]
 
     def _run_remote_inject(self, seq: Sequence) -> None:
         """Disaggregated admission: prompt KV was computed by a remote
-        prefill worker — inject it and go straight to decode."""
+        prefill worker — inject it (one batched scatter) and go straight
+        to decode."""
         self._apply_uploads(seq)
         ps = self.cfg.page_size
         rk = seq.remote_kv
         n_pages = (len(seq.prompt) + ps - 1) // ps
         start = seq.cached_len // ps  # locally matched/uploaded prefix
-        for i in range(start, min(n_pages, len(rk.pages))):
-            hk, hv = rk.pages[i]
-            self.k_cache, self.v_cache = self._inject_page(
-                self.k_cache,
-                self.v_cache,
-                seq.page_ids[i],
-                jnp.asarray(hk),
-                jnp.asarray(hv),
+        end = min(n_pages, len(rk.pages))
+        if end > start:
+            self._inject_page_batch(
+                seq.page_ids[start:end],
+                [rk.pages[i][0] for i in range(start, end)],
+                [rk.pages[i][1] for i in range(start, end)],
+                op="inject",
             )
         seq.remote_kv = None  # drop the host copy the moment it's injected
         seq.remote_prefilled = True
         self._finish_first_token(seq, rk.first_token)
 
-    def _run_prefill_chunk(self, batch: list[Sequence]) -> None:
+    def _dispatch_prefill_chunk(
+        self, batch: list[Sequence]
+    ) -> _PendingPrefill | None:
         """One batched prefill dispatch: up to ``prefill_batch`` PREFILL
         sequences each contribute their next ``prefill_chunk``-token
         slice of prompt. Rows/tokens are bucketed so steady state hits a
         small set of compiled variants; rows whose prompt completes this
         chunk get their first token sampled (per-row sampling params) and
-        graduate to decode."""
+        graduate to decode when the pending result is consumed."""
         cfg = self.cfg
         ps = cfg.page_size
         rows = cfg.rows_bucket_for(len(batch))
@@ -715,6 +991,7 @@ class TPUEngine(AsyncEngine):
             self._wants_logprobs(seq) is not None for seq in batch
         )
         fn = self._prefill_fn(rows, bucket, attn_pages, want_lp)
+        self._flush_offloads()
         ys, self.k_cache, self.v_cache, self._rng = fn(
             self.params,
             self.k_cache,
@@ -728,47 +1005,79 @@ class TPUEngine(AsyncEngine):
             jnp.asarray(top_k),
             jnp.asarray(top_p),
         )
-        if completed:
-            if want_lp:
-                toks, lps, top_ids, top_lps = (np.asarray(y) for y in ys)
-            else:
-                toks = np.asarray(ys[0])
-            for i, seq in completed:
-                n_top = self._wants_logprobs(seq)
-                pack = (
-                    self._lp_pack(
-                        n_top, lps[i : i + 1],
-                        top_ids[i : i + 1], top_lps[i : i + 1],
-                    )
-                    if want_lp and n_top is not None
-                    else None
+        return _PendingPrefill(ys=ys, completed=completed, want_lp=want_lp)
+
+    def _consume_prefill(self, pending: _PendingPrefill) -> None:
+        """Host sync of a prefill chunk: sample-complete rows emit their
+        first token and join decode. Runs after the decode window for
+        this iteration has been dispatched, so the sync overlaps device
+        compute instead of serializing ahead of it."""
+        if not pending.completed:
+            return
+        if pending.want_lp:
+            toks, lps, top_ids, top_lps = (np.asarray(y) for y in pending.ys)
+        else:
+            toks = np.asarray(pending.ys[0])
+        for i, seq in pending.completed:
+            n_top = self._wants_logprobs(seq)
+            pack = (
+                self._lp_pack(
+                    n_top, lps[i : i + 1],
+                    top_ids[i : i + 1], top_lps[i : i + 1],
                 )
-                self._finish_first_token(seq, int(toks[i]), pack)
+                if pending.want_lp and n_top is not None
+                else None
+            )
+            self._finish_first_token(seq, int(toks[i]), pack)
 
     # ----------------------------------------------------------------- decode
-    def _run_decode(self) -> bool:
-        """One decode *window* (``decode_window`` on-device steps, one
-        host sync) over every ACTIVE slot. Returns False when nothing
-        could step (page pool dry)."""
-        cfg = self.cfg
-        ps = cfg.page_size
-        B = cfg.max_decode_slots
-        K = cfg.decode_window
-        tokens = np.zeros(B, np.int32)
-        positions = np.full(B, -1, np.int32)
-        max_pos = np.full(B, -1, np.int32)
-        table = np.zeros((B, cfg.max_pages_per_seq), np.int32)
-        temp = np.zeros(B, np.float32)
-        top_k = np.zeros(B, np.int32)
-        top_p = np.ones(B, np.float32)
-        freq = np.zeros(B, np.float32)
-        pres = np.zeros(B, np.float32)
-        rep = np.ones(B, np.float32)
+    @staticmethod
+    def _needs_sampler(seq: Sequence) -> bool:
+        """True when the row needs the full penalty/top-k/top-p sampler
+        (vs the greedy fast path)."""
+        so = seq.stop.sampling_options
+        return bool(
+            (so.temperature or 0.0) > 0.0
+            or so.frequency_penalty
+            or so.presence_penalty
+            or (so.repetition_penalty or 1.0) != 1.0
+        )
 
-        stepped: list[tuple[Sequence, int]] = []  # (seq, valid steps)
-        max_pages = 1
-        full_sampler = False
-        for i, seq in enumerate(self.sched.slots):
+    def _stop_gates(self, seq: Sequence, g0: int) -> tuple[int, int]:
+        """On-device stop gates for a row whose window starts with ``g0``
+        tokens already generated. Gates are window-step indices t
+        (0-based): EOS is actionable at t >= eos_gate (mirrors
+        check_stop's min_tokens rule), and the row's max_tokens budget
+        runs out after the token sampled at t == budget_gate."""
+        sc = seq.stop.stop_conditions
+        eos_gate = max((sc.min_tokens or 0) - g0 - 1, 0)
+        max_tokens = sc.max_tokens or self.cfg.default_max_tokens
+        budget_gate = max(max_tokens - g0 - 1, 0)
+        return eos_gate, budget_gate
+
+    def _stop_set(self, seq: Sequence) -> list[int]:
+        """The row's on-device stop-token set (static for its lifetime;
+        a chained window reuses the already-built array). Overflowing
+        sets truncate — the host's check_stop remains authoritative."""
+        sc = seq.stop.stop_conditions
+        if sc.ignore_eos:
+            return []
+        stops = list(self.cfg.eos_token_ids) + list(sc.stop_token_ids)
+        return stops[: self.cfg.device_stop_width]
+
+    def _dispatch_decode(self) -> list[_PendingDecode]:
+        """Dispatch this iteration's decode window(s) over the ACTIVE
+        slots: rows are compacted (no dead slots) and partitioned into a
+        greedy window and a full-sampler window, each compiled at its
+        own row bucket — so decode cost tracks occupancy and a lone
+        creative request doesn't drag greedy rows through the sampler.
+        Returns the pending (unsynced) dispatches; [] when nothing could
+        step (no ACTIVE rows / page pool dry)."""
+        cfg = self.cfg
+        ps, K = cfg.page_size, cfg.decode_window
+        greedy: list[tuple[Sequence, int, int]] = []  # (seq, wpos, cap)
+        sampler: list[tuple[Sequence, int, int]] = []
+        for seq in self.sched.slots:
             if seq is None or seq.state is not SeqState.ACTIVE:
                 continue
             wpos = len(seq.tokens) - 1  # position of the token being fed
@@ -782,57 +1091,250 @@ class TPUEngine(AsyncEngine):
             seq.stalled = len(seq.page_ids) * ps < min(
                 wpos + K, cfg.max_model_len
             )
-            tokens[i] = seq.last_token()
-            positions[i] = wpos
-            max_pos[i] = cap
-            table[i, : len(seq.page_ids)] = seq.page_ids
+            part = sampler if self._needs_sampler(seq) else greedy
+            part.append((seq, wpos, cap))
+        out: list[_PendingDecode] = []
+        solo = bool(greedy) != bool(sampler)
+        for part, full_sampler in ((greedy, False), (sampler, True)):
+            if part:
+                out.append(self._dispatch_partition(part, full_sampler, solo))
+        return out
+
+    def _dispatch_partition(
+        self,
+        part: list[tuple[Sequence, int, int]],
+        full_sampler: bool,
+        solo: bool,
+    ) -> _PendingDecode:
+        """Build + dispatch one compacted decode window (no host sync)."""
+        cfg = self.cfg
+        ps, K, S = cfg.page_size, cfg.decode_window, cfg.device_stop_width
+        rows = cfg.decode_rows_bucket_for(len(part))
+        tokens = np.zeros(rows, np.int32)
+        positions = np.full(rows, -1, np.int32)
+        max_pos = np.full(rows, -1, np.int32)
+        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
+        # Pad rows map to the scratch counts row (B) so their scatter
+        # can't touch a live slot.
+        slot_map = np.full(rows, cfg.max_decode_slots, np.int32)
+        stop_set = np.full((rows, S), -1, np.int32)
+        eos_gate = np.zeros(rows, np.int32)
+        budget_gate = np.full(rows, K, np.int32)  # pad: never fires
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int32)
+        top_p = np.ones(rows, np.float32)
+        freq = np.zeros(rows, np.float32)
+        pres = np.zeros(rows, np.float32)
+        rep = np.ones(rows, np.float32)
+
+        stepped: list[tuple[Sequence, int, int]] = []
+        max_pages = 1
+        capacity_capped = False
+        for r, (seq, wpos, cap) in enumerate(part):
+            capacity_capped = capacity_capped or cap < wpos + K
+            tokens[r] = seq.last_token()
+            positions[r] = wpos
+            max_pos[r] = cap
+            table[r, : len(seq.page_ids)] = seq.page_ids
+            slot_map[r] = seq.slot
             max_pages = max(max_pages, (min(wpos + K, cap + 1) + ps - 1) // ps)
+            stops = self._stop_set(seq)
+            stop_set[r, : len(stops)] = stops
+            eos_gate[r], budget_gate[r] = self._stop_gates(seq, seq.generated)
             so = seq.stop.sampling_options
-            temp[i] = so.temperature if so.temperature is not None else 0.0
-            top_k[i] = so.top_k or 0
-            top_p[i] = so.top_p if so.top_p is not None else 1.0
-            freq[i] = so.frequency_penalty or 0.0
-            pres[i] = so.presence_penalty or 0.0
-            rep[i] = so.repetition_penalty or 1.0
-            if temp[i] > 0.0 or freq[i] or pres[i] or rep[i] != 1.0:
-                full_sampler = True
-            stepped.append((seq, min(K, cap - wpos + 1)))
-        if not stepped:
-            return False
+            temp[r] = so.temperature if so.temperature is not None else 0.0
+            top_k[r] = so.top_k or 0
+            top_p[r] = so.top_p if so.top_p is not None else 1.0
+            freq[r] = so.frequency_penalty or 0.0
+            pres[r] = so.presence_penalty or 0.0
+            rep[r] = so.repetition_penalty or 1.0
+            stepped.append((seq, min(K, cap - wpos + 1), r))
 
         want_lp = any(
-            self._wants_logprobs(seq) is not None for seq, _ in stepped
+            self._wants_logprobs(seq) is not None for seq, _, _ in stepped
         )
         fn = self._decode_fn(
-            cfg.page_bucket_for(max_pages), full_sampler, want_lp
+            rows, cfg.page_bucket_for(max_pages), full_sampler, want_lp
         )
-        ys, self.k_cache, self.v_cache, self._rng, self._counts = fn(
-            self.params,
-            self.k_cache,
-            self.v_cache,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(max_pos),
-            jnp.asarray(table),
-            self._rng,
-            self._counts,
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-            jnp.asarray(freq),
-            jnp.asarray(pres),
-            jnp.asarray(rep),
-        )
-        self.steps += K
-        # [K, B] (+ [K, B, N] tops when want_lp) — one sync per window.
-        if want_lp:
-            sampled, lps, top_ids, top_lps = (np.asarray(y) for y in ys)
+        self._flush_offloads()
+        sampler_args = (temp, top_k, top_p, freq, pres, rep)
+        if full_sampler:
+            (ys, self.k_cache, self.v_cache, self._rng, self._counts,
+             tok_dev, pos_dev) = fn(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(max_pos), jnp.asarray(table),
+                self._rng, self._counts, jnp.asarray(slot_map),
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(freq), jnp.asarray(pres), jnp.asarray(rep),
+                jnp.asarray(stop_set), jnp.asarray(eos_gate),
+                jnp.asarray(budget_gate),
+            )
         else:
-            sampled = np.asarray(ys[0])
-        for seq, n_valid in stepped:
+            ys, self.k_cache, self.v_cache, tok_dev, pos_dev = fn(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(max_pos), jnp.asarray(table),
+                jnp.asarray(stop_set), jnp.asarray(eos_gate),
+                jnp.asarray(budget_gate),
+            )
+        self.steps += K
+        get_telemetry().decode_batch_rows.observe(len(part))
+        return _PendingDecode(
+            ys=ys,
+            tokens_dev=tok_dev,
+            positions_dev=pos_dev,
+            stepped=stepped,
+            rows=rows,
+            full_sampler=full_sampler,
+            want_lp=want_lp,
+            solo=solo,
+            capacity_capped=capacity_capped,
+            stop_tokens=stop_set,
+            sampler_args=sampler_args if full_sampler else None,
+            slot_map=slot_map if full_sampler else None,
+        )
+
+    def _can_chain(self) -> bool:
+        """Whether the next window may launch straight from the inflight
+        window's device carry, before the host syncs. Requires a stable
+        steady state: nothing waiting or prefilling, no cancellations,
+        a single (solo) partition, and at least one row the host knows
+        will outlive the inflight window (otherwise the chained window
+        would compute only discards)."""
+        p = self._inflight
+        if p is None or not p.solo or not self.cfg.chained_decode:
+            return False
+        if p.capacity_capped:
+            return False  # a capped row's carry is dead but resumable
+        if not self._submit_q.empty() or self.sched.waiting:
+            return False
+        stepped_seqs = {id(seq) for seq, _, _ in p.stepped}
+        for s in self.sched.slots:
+            if s is None:
+                continue
+            if s.state is SeqState.PREFILL:
+                return False
+            if s.is_cancelled():
+                return False
+            if s.state is SeqState.ACTIVE and id(s) not in stepped_seqs:
+                # A row joined (finished prefill) or sat out (stalled)
+                # after the chain started; chaining over the old row set
+                # would starve it — rebuild a fresh compacted window.
+                return False
+        K = self.cfg.decode_window
+        for seq, n_valid, _ in p.stepped:
+            sc = seq.stop.stop_conditions
+            max_tokens = sc.max_tokens or self.cfg.default_max_tokens
+            if n_valid >= K and max_tokens - seq.generated > K:
+                return True  # a survivor makes the chained window useful
+        return False
+
+    def _dispatch_chained(
+        self, pending: _PendingDecode
+    ) -> _PendingDecode | None:
+        """Dispatch window N+1 over window N's rows using N's on-device
+        carry (tokens/positions) as inputs — no host round-trip. The
+        host view of these rows lags one window: positions advance by
+        exactly ``decode_window`` for every surviving row (a row the
+        device stopped carries position -1 and computes into discards
+        the host skips at consume). Pages are provisioned one extra
+        window ahead; returns None (chain break) when the pool can't
+        cover a row."""
+        cfg = self.cfg
+        ps, K = cfg.page_size, cfg.decode_window
+        rows = pending.rows
+        max_pos = np.full(rows, -1, np.int32)
+        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
+        stop_set = pending.stop_tokens  # same rows, same stop sets
+        eos_gate = np.zeros(rows, np.int32)
+        budget_gate = np.full(rows, K, np.int32)
+        stepped: list[tuple[Sequence, int, int]] = []
+        max_pages = 1
+        capacity_capped = False
+        for seq, _, r in pending.stepped:
+            wpos = len(seq.tokens) - 1 + K  # host view + inflight window
+            self.sched.ensure_pages_until(seq, wpos + K - 1)
+            cap = min(cfg.max_model_len, len(seq.page_ids) * ps) - 1
+            if cap < wpos:
+                return None  # pool dry: consume + rebuild instead
+            capacity_capped = capacity_capped or cap < wpos + K
+            max_pos[r] = cap
+            table[r, : len(seq.page_ids)] = seq.page_ids
+            max_pages = max(max_pages, (min(wpos + K, cap + 1) + ps - 1) // ps)
+            eos_gate[r], budget_gate[r] = self._stop_gates(
+                seq, seq.generated + K
+            )
+            stepped.append((seq, min(K, cap - wpos + 1), r))
+        fn = self._decode_fn(
+            rows,
+            cfg.page_bucket_for(max_pages),
+            pending.full_sampler,
+            pending.want_lp,
+        )
+        self._flush_offloads()
+        if pending.full_sampler:
+            temp, top_k, top_p, freq, pres, rep = pending.sampler_args
+            (ys, self.k_cache, self.v_cache, self._rng, self._counts,
+             tok_dev, pos_dev) = fn(
+                self.params, self.k_cache, self.v_cache,
+                pending.tokens_dev, pending.positions_dev,
+                jnp.asarray(max_pos), jnp.asarray(table),
+                self._rng, self._counts, jnp.asarray(pending.slot_map),
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(freq), jnp.asarray(pres), jnp.asarray(rep),
+                jnp.asarray(stop_set), jnp.asarray(eos_gate),
+                jnp.asarray(budget_gate),
+            )
+        else:
+            ys, self.k_cache, self.v_cache, tok_dev, pos_dev = fn(
+                self.params, self.k_cache, self.v_cache,
+                pending.tokens_dev, pending.positions_dev,
+                jnp.asarray(max_pos), jnp.asarray(table),
+                jnp.asarray(stop_set), jnp.asarray(eos_gate),
+                jnp.asarray(budget_gate),
+            )
+        self.steps += K
+        get_telemetry().decode_batch_rows.observe(len(stepped))
+        return _PendingDecode(
+            ys=ys,
+            tokens_dev=tok_dev,
+            positions_dev=pos_dev,
+            stepped=stepped,
+            rows=rows,
+            full_sampler=pending.full_sampler,
+            want_lp=pending.want_lp,
+            solo=True,
+            capacity_capped=capacity_capped,
+            stop_tokens=stop_set,
+            sampler_args=pending.sampler_args,
+            slot_map=pending.slot_map,
+        )
+
+    def _consume_decode(self, pending: _PendingDecode) -> None:
+        """Host sync of one decode window: emit kept tokens, run the
+        authoritative check_stop, register completed pages. A stop found
+        while a chained successor is still in flight defers the finish
+        (page release) until that successor is force-consumed — the
+        device already parked the row at position -1, so the successor
+        writes nothing for it."""
+        K = self.cfg.decode_window
+        if pending.want_lp:
+            sampled, lps, top_ids, top_lps = (
+                np.asarray(y) for y in pending.ys
+            )
+        else:
+            sampled = np.asarray(pending.ys[0])
+        tel = get_telemetry()
+        finishes: list[Sequence] = []
+        wasted = 0
+        for seq, n_valid, row in pending.stepped:
+            if seq.state is not SeqState.ACTIVE or seq.pending_finish is not None:
+                wasted += n_valid  # whole window past this row's stop
+                continue
             kept: list[int] = []
             reason = None
-            for token in sampled[:n_valid, seq.slot]:
+            for token in sampled[:n_valid, row]:
                 token = int(token)
                 kept.append(token)
                 seq.tokens.append(token)
@@ -840,6 +1342,7 @@ class TPUEngine(AsyncEngine):
                 reason = self.sched.check_stop(seq, token)
                 if reason is not None:
                     break
+            wasted += n_valid - len(kept)
             self.sched.register_full_pages(seq)
             n_top = self._wants_logprobs(seq)
             pack = None
@@ -847,24 +1350,47 @@ class TPUEngine(AsyncEngine):
                 n = len(kept)
                 pack = self._lp_pack(
                     n_top,
-                    lps[:n, seq.slot],
-                    top_ids[:n, seq.slot],
-                    top_lps[:n, seq.slot],
+                    lps[:n, row],
+                    top_ids[:n, row],
+                    top_lps[:n, row],
                 )
             if kept:
                 now = time.time()
                 if seq.last_emit_at:
                     tbt = max(now - seq.last_emit_at, 0.0) / len(kept)
-                    get_telemetry().time_between_tokens.observe(tbt)
+                    tel.time_between_tokens.observe(tbt)
                 seq.last_emit_at = now
             seq.emit(kept, None, pack)
             if reason is not None:
+                seq.pending_finish = reason
+                finishes.append(seq)
+        if wasted:
+            self.wasted_steps += wasted
+            tel.decode_wasted_steps.inc(wasted)
+        if finishes:
+            # Pages about to be released must not have a window in
+            # flight over them: sync the chained successor first (its
+            # surviving rows' tokens are consumed normally; rows with a
+            # pending finish are skipped above).
+            succ, self._inflight = self._inflight, None
+            if succ is not None:
+                self._consume_decode(succ)
+            for seq in finishes:
+                reason, seq.pending_finish = seq.pending_finish, None
                 self.sched.finish(seq, reason)
-        return True
 
     # --------------------------------------------------------------- metrics
     def metrics(self) -> dict:
         m = self.sched.metrics()
+        # Occupancy-proportional decode counters (docs/engine_perf.md):
+        # bench.py's occupancy sweep and the proportionality tests read
+        # these; /metrics exposes the prometheus mirrors.
+        m["decode_steps"] = self.steps
+        m["decode_wasted_steps"] = self.wasted_steps
+        m["kv_page_moves"] = self.kv_page_moves
+        m["kv_move_dispatches"] = self.kv_move_dispatches
+        m["compiled_decode_variants"] = len(self._decode_fns)
+        m["compiled_prefill_variants"] = len(self._prefill_fns)
         if self.host_pool is not None:
             m["host_cache_resident"] = self.host_pool.resident
             m["host_cache_hits"] = self.host_pool.hits

@@ -128,11 +128,16 @@ class CopyStream:
         self.dropped = 0
         self._thread.start()
 
-    def offload(self, seq_hash: int, k_dev, v_dev) -> None:
+    def offload_batch(self, seq_hashes: list, k_dev, v_dev) -> None:
+        """Coalesced offload: one gathered [L, n, ps, HkvD] K/V pair
+        covering ``len(seq_hashes)`` pages (page axis 1). The worker
+        materializes the whole batch with ONE host transfer and commits
+        page-by-page — an eviction burst costs one dispatch + one sync
+        instead of one per page."""
         try:
-            self._q.put_nowait((seq_hash, k_dev, v_dev))
+            self._q.put_nowait((list(seq_hashes), k_dev, v_dev))
         except queue.Full:
-            self.dropped += 1
+            self.dropped += len(seq_hashes)
 
     def drain(self, timeout: float = 10.0) -> None:
         """Block until every queued offload has *committed* (tests)."""
@@ -158,9 +163,11 @@ class CopyStream:
             try:
                 if item is None:
                     return
-                seq_hash, k_dev, v_dev = item
-                self.pool.store(seq_hash, np.asarray(k_dev), np.asarray(v_dev))
+                seq_hashes, k_dev, v_dev = item
+                k_np, v_np = np.asarray(k_dev), np.asarray(v_dev)
+                for j, h in enumerate(seq_hashes):
+                    self.pool.store(h, k_np[:, j], v_np[:, j])
             except Exception:  # never kill the stream on one bad page
-                log.exception("KV offload of page %x failed", item[0])
+                log.exception("KV offload of page(s) %s failed", item[0])
             finally:
                 self._q.task_done()

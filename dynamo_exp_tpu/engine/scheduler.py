@@ -78,6 +78,12 @@ class Sequence:
     hashed_pages: int = 0  # count of pages already registered
     # Set when the pool ran dry mid-decode; slot idles until a page frees.
     stalled: bool = False
+    # Stop discovered while a chained decode window was still in flight:
+    # the finish (and its page release) is deferred until that window is
+    # consumed, so the device can't write into reallocated pages. The
+    # on-device stop already flipped the row's position to -1, making
+    # the in-flight window's output for it pure discard.
+    pending_finish: "FinishReason | None" = None
     # G2→G1 injections the engine must dispatch before this prefill:
     # (page_id, seq_hash, k_page, v_page) per page (see kv_manager).
     pending_uploads: list = field(default_factory=list)

@@ -499,7 +499,7 @@ def _pallas_decode(q, kp, vp, page_table, lengths, hkv, mesh, interpret):
             q, kp, vp, page_table, lengths, num_kv_heads=hkv,
             interpret=interpret,
         )
-    from jax import shard_map
+    from ..parallel.mesh import shard_map
 
     @_partial(
         shard_map,
@@ -557,7 +557,7 @@ def forward_ring_prefill(
     """
     from functools import partial as _partial
 
-    from jax import shard_map
+    from ..parallel.mesh import shard_map
 
     from ..ops.ring_attention import ring_attention
 

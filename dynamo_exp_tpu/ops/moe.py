@@ -113,7 +113,7 @@ def moe_ffn_ep(
     """
     from functools import partial as _partial
 
-    from jax import shard_map
+    from ..parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
     N, D = x.shape

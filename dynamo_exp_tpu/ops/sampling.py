@@ -77,6 +77,18 @@ def apply_penalties(
     return logits
 
 
+def stop_token_hit(
+    tokens: jnp.ndarray,  # [B] int32 sampled token ids
+    stop_sets: jnp.ndarray,  # [B, S] int32, -1 padded (never matches)
+) -> jnp.ndarray:
+    """Per-row on-device stop detection: True where the sampled token is
+    in the row's (padded) stop set. Rows whose request disables EOS
+    (ignore_eos) pass an all -1 set. Runs inside the decode window scan
+    so a finished row flips its position to -1 mid-window instead of
+    writing garbage KV the host later discards."""
+    return jnp.any(tokens[:, None] == stop_sets, axis=-1)
+
+
 # Top-N alternatives reported alongside every chosen-token logprob; the
 # host slices down to each request's top_logprobs (OpenAI caps at 20,
 # but 5 covers the common ask without widening the per-window sync).

@@ -1,4 +1,11 @@
-from .mesh import build_mesh, largest_tp, shard, shard_pytree, single_device_mesh
+from .mesh import (
+    build_mesh,
+    largest_tp,
+    shard,
+    shard_map,
+    shard_pytree,
+    single_device_mesh,
+)
 from .multihost import (
     MultiNodeConfig,
     bringup,
@@ -11,6 +18,7 @@ __all__ = [
     "build_mesh",
     "single_device_mesh",
     "shard",
+    "shard_map",
     "shard_pytree",
     "largest_tp",
     "MultiNodeConfig",

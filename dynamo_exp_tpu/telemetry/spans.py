@@ -154,6 +154,29 @@ class Telemetry:
             key: Gauge(f"dynamo_engine_{key}", help_, registry=self.registry)
             for key, help_ in _ENGINE_GAUGES
         }
+        # Occupancy-proportional decode (docs/engine_perf.md): how many
+        # rows each compiled decode window actually computed, window
+        # steps spent past a row's stop point, and KV pages moved by the
+        # batched gather/scatter paths. The counters are incremented at
+        # the engine loop's consume/move sites (prometheus counters are
+        # thread-safe); the gauges ride the engine-gauge publisher.
+        self.decode_batch_rows = Histogram(
+            "dynamo_decode_batch_rows",
+            "True (uncompacted-slot-free) rows per decode window dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128),
+            registry=self.registry,
+        )
+        self.decode_wasted_steps = Counter(
+            "dynamo_decode_wasted_steps_total",
+            "Decode window steps computed for a row past its stop point",
+            registry=self.registry,
+        )
+        self.kv_page_moves = Counter(
+            "dynamo_kv_page_moves_total",
+            "KV pages moved by batched gather/scatter, by operation",
+            ["op"],  # extract | inject | upload | offload
+            registry=self.registry,
+        )
         # Fault-tolerance counters (docs/fault_tolerance.md): retries and
         # failovers on the request plane, circuit-breaker churn, requests
         # abandoned at their deadline per stage, and drain lifecycle.

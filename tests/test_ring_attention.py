@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from dynamo_exp_tpu.parallel import shard_map
 from jax.sharding import PartitionSpec as P
 
 from dynamo_exp_tpu.models import TINY, forward, init_kv_cache, init_params
