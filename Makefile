@@ -6,6 +6,9 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 # run must inject the same faults at the same points (the suite itself
 # asserts cross-run determinism per seed).
 CHAOS_SEED_SETS := 7,21,1337 11,23,4242 1,2,3
+# Recovery seed set: the mid-stream-failover (resumable streams) suite
+# sweeps crash-at-token faults under these seeds pre-merge.
+RECOVERY_SEED_SETS := 7,21,1337 5,8,13
 
 .PHONY: test pre-merge nightly chaos lint
 
@@ -19,11 +22,17 @@ nightly:
 	$(PYTEST) tests/ -q -m "not tpu and not weekly"
 
 # Fault-injection suite under three fixed seed sets (satellite of the
-# fault-tolerance PR; see docs/fault_tolerance.md).
+# fault-tolerance PR; see docs/fault_tolerance.md), plus the resumable
+# streams / mid-stream failover suite under its recovery seed sets —
+# both run pre-merge.
 chaos:
 	@set -e; for seeds in $(CHAOS_SEED_SETS); do \
 		echo "=== chaos suite, CHAOS_SEEDS=$$seeds ==="; \
 		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_fault_tolerance.py -q -m chaos; \
+	done; \
+	for seeds in $(RECOVERY_SEED_SETS); do \
+		echo "=== recovery suite, CHAOS_SEEDS=$$seeds ==="; \
+		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_resumable.py -q -m chaos; \
 	done
 
 lint:

@@ -68,6 +68,15 @@ _NIGHTLY_FILES = {
 # Individually slow tests inside otherwise pre_merge files.
 _NIGHTLY_TESTS = {
     "test_concurrent_requests_batch",  # 110s: full batching soak
+    # Real-TPUEngine resumable-generation proofs (compile-heavy; the
+    # request-plane resumable tests in the same file stay pre_merge).
+    "test_engine_greedy_continuation_token_identical",
+    "test_engine_seeded_sampling_continuation_identical",
+    "test_engine_penalized_continuation_restores_counts",
+    "test_engine_lease_reaper_reclaims_orphaned_extract",
+    "test_engine_lease_confirm_releases_without_reclaim",
+    "test_prefill_worker_leaves_lease_to_reaper_on_delivery_failure",
+    "test_sse_stream_gapless_and_duplicate_free_across_failover",
 }
 
 

@@ -74,7 +74,14 @@ class DisaggDecodeEngine(AsyncEngine):
             and await self._should_prefill_remote(binput)
             and self.breaker.allow()
         ):
-            remote_kv = await self._remote_prefill(binput, ctx)
+            try:
+                remote_kv = await self._remote_prefill(binput, ctx)
+            except BaseException:
+                # _remote_prefill records success/failure for everything
+                # it catches; only cancellation (and kin) escapes — free
+                # the claimed half-open probe slot without an outcome.
+                self.breaker.release()
+                raise
         return await self.engine.generate(binput, ctx, remote_kv=remote_kv)
 
     async def _should_prefill_remote(self, binput: BackendInput) -> bool:
@@ -114,7 +121,13 @@ class DisaggDecodeEngine(AsyncEngine):
         if remaining is not None:
             timeout = min(timeout, max(remaining, 0.0))
         with trace_span(
-            "remote_prefill", request_id=rid, prompt_tokens=len(binput.token_ids)
+            "remote_prefill",
+            request_id=rid,
+            prompt_tokens=len(binput.token_ids),
+            # Failover continuation (prompt + journaled tokens being
+            # re-prefilled) — visible in `llmctl trace` as the re-prefill
+            # hop's remote leg.
+            resumed_tokens=binput.resume_offset or None,
         ) as sp:
             # The span's own context rides the queue, so the prefill
             # worker's spans (engine queue wait, prefill compute, KV
@@ -152,9 +165,14 @@ class DisaggDecodeEngine(AsyncEngine):
                 # nothing about fleet health — only count fleet-attributable
                 # failures toward the breaker, or three short-deadline
                 # requests would lock healthy remote prefill out for a
-                # whole cooldown.
+                # whole cooldown. But allow() may have claimed the
+                # half-open probe slot: a no-outcome exit must RELEASE
+                # it, or the breaker sticks in HALF_OPEN and remote
+                # prefill is locked out forever (ROADMAP open item).
                 if not ctx.deadline_expired:
                     self.breaker.record_failure()
+                else:
+                    self.breaker.release()
                 sp.set(outcome="local_fallback")
                 return None
 

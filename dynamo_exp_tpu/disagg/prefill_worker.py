@@ -17,7 +17,7 @@ from ..protocols.common import BackendInput, SamplingOptions
 from ..runtime.runtime import CancellationToken
 from ..runtime.transports.base import WorkQueue
 from ..telemetry import TraceContext, adopt, get_telemetry
-from .protocol import RemotePrefillRequest, kv_signature
+from .protocol import LeaseGrant, RemotePrefillRequest, kv_signature
 from .transfer import send_kv_pages
 
 logger = logging.getLogger(__name__)
@@ -116,21 +116,40 @@ class PrefillWorker:
                     token_ids=req.token_ids,
                     sampling_options=SamplingOptions(**req.sampling_options),
                 )
-                first_token, pages = await self.engine.prefill_extract(binput)
+                first_token, pages, lease_id = await self.engine.prefill_extract(
+                    binput
+                )
             except Exception as e:  # noqa: BLE001 - report upstream, keep serving
                 logger.exception("prefill failed for %s", req.request_id)
                 await self._fail(req, f"{type(e).__name__}: {e}")
                 return
+            lease = (
+                LeaseGrant(lease_id, self.engine.cfg.kv_lease_ttl_s)
+                if lease_id
+                else None
+            )
             try:
                 await send_kv_pages(
-                    req.return_addr, req.request_id, first_token, pages
+                    req.return_addr, req.request_id, first_token, pages,
+                    lease=lease,
                 )
+                # Delivery acked end-to-end: the decode side owns a host
+                # copy of every page, so the handoff lease is confirmed
+                # and the pinned device pages may park for reuse.
+                if lease_id:
+                    self.engine.confirm_kv_lease(lease_id)
                 self.served += 1
             except Exception:  # noqa: BLE001 - a delivery failure (decode worker
                 # died, dropped the connection pre-ack, …) must never kill the
                 # pull loop; the decode side times out and prefills locally.
+                # The handoff lease is deliberately NOT confirmed: the
+                # engine's reaper reclaims the pinned pages at expiry, so
+                # a decode death between extract and inject can't strand
+                # HBM (and a late re-connection can't find them gone
+                # early either).
                 logger.warning(
-                    "KV delivery failed for %s", req.request_id, exc_info=True
+                    "KV delivery failed for %s (lease %s left to the reaper)",
+                    req.request_id, lease_id or "-", exc_info=True,
                 )
                 self.failed += 1
 

@@ -68,3 +68,30 @@ class RemotePrefillRequest:
         d = json.loads(raw)
         known = {f.name for f in cls.__dataclass_fields__.values()}
         return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class LeaseGrant:
+    """KV-handoff lease metadata riding the transfer BEGIN frame.
+
+    The prefill worker pins the extracted device pages under this lease
+    until the transfer's final ack confirms delivery; if the decode
+    instance dies between extract and inject, the worker's engine-loop
+    reaper reclaims the pages once ``ttl_s`` passes (lease state
+    machine: GRANTED → CONFIRMED | EXPIRED, docs/fault_tolerance.md).
+    The receive side gets the grant for tracing and diagnostics — the
+    confirm itself is the transfer ack, so no extra round-trip exists to
+    lose."""
+
+    lease_id: str
+    ttl_s: float = 0.0
+
+    def to_header(self) -> dict:
+        return {"lease_id": self.lease_id, "lease_ttl_s": self.ttl_s}
+
+    @classmethod
+    def from_header(cls, header: dict) -> "LeaseGrant | None":
+        lid = header.get("lease_id")
+        if not lid:
+            return None
+        return cls(lease_id=lid, ttl_s=float(header.get("lease_ttl_s") or 0.0))

@@ -92,13 +92,17 @@ async def send_kv_pages(
     error: str | None = None,
     chunk_pages: int = DEFAULT_CHUNK_PAGES,
     window: int = DEFAULT_WINDOW,
+    lease: "object | None" = None,  # disagg.protocol.LeaseGrant
 ) -> None:
     """Deliver one prefill result (or failure notice) to a decode worker.
 
     Pages go out as ``chunk_pages``-page DATA frames with at most
     ``window`` frames unacknowledged — per-frame memory at both ends is
     capped at ``chunk_pages * page_bytes`` regardless of prompt length,
-    and arrival overlaps transmission.
+    and arrival overlaps transmission. ``lease`` (if the sender pinned
+    the source pages under a handoff lease) rides the BEGIN frame so the
+    receive side can trace which lease covered the transfer; a clean
+    final ack is the sender's cue to confirm the lease.
     """
     host, _, port = return_addr.rpartition(":")
     t0 = time.time()
@@ -148,6 +152,8 @@ async def send_kv_pages(
         trace = wire_headers()
         if trace:
             begin["trace"] = trace
+        if lease is not None:
+            begin.update(lease.to_header())
         await write_message(writer, TwoPartMessage(MsgType.FRAME, begin))
         unacked = 0
         for idx, chunk in enumerate(chunks):
@@ -269,6 +275,7 @@ class KvPageReceiver:
                     RuntimeError(msg.header.get("error", "prefill failed"))
                 )
             elif msg.header.get("kind") == "begin":
+                begin_header = msg.header
                 first_token = msg.header["first_token"]
                 t0 = time.time()
                 n_bytes = 0
@@ -308,6 +315,9 @@ class KvPageReceiver:
                     request_id=rid,
                     pages=n_pages,
                     bytes=n_bytes,
+                    # Which handoff lease covered this transfer (tracing
+                    # orphan reclaims back to their request).
+                    lease_id=begin_header.get("lease_id"),
                 )
             else:
                 # Unchunked single-frame transfers are rejected outright:

@@ -76,6 +76,12 @@ class EngineConfig:
     host_cache_pages: int = 0
     # Emit KV stored/removed events for the router index.
     enable_kv_events: bool = True
+    # Disaggregation KV-handoff lease TTL: extracted prompt pages stay
+    # pinned in HBM this long awaiting the decode worker's delivery ack;
+    # the engine-loop reaper reclaims orphans (decode instance died
+    # between extract and inject) once it passes. Must comfortably cover
+    # one prefill-to-decode transfer (docs/fault_tolerance.md).
+    kv_lease_ttl_s: float = 30.0
 
     def __post_init__(self):
         if not self.prefill_buckets:

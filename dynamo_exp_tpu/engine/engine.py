@@ -43,6 +43,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import queue
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -66,7 +67,7 @@ from ..models.llama import (
 )
 from ..ops.sampling import (
     apply_penalties,
-    sample_tokens,
+    sample_tokens_seeded,
     stop_token_hit,
     token_logprobs,
 )
@@ -109,7 +110,8 @@ class _PendingDecode:
     # rows at consume and skips them in the successor).
     capacity_capped: bool
     stop_tokens: object  # np [rows, S], reused verbatim by a chain
-    sampler_args: tuple | None = None  # (temp, top_k, top_p, f, p, r) np
+    # (seeds, temp, top_k, top_p, f, p, r) np arrays, reused by a chain.
+    sampler_args: tuple | None = None
     slot_map: object | None = None  # np [rows] (sampler variants only)
 
 
@@ -216,7 +218,12 @@ class TPUEngine(AsyncEngine):
         # padding rows point here, so pad scatters never touch a live
         # slot's counts.
         self._counts = jnp.zeros((B + 1, V), jnp.int32)
-        self._rng = jax.random.PRNGKey(seed + 1)
+        # Sampling is counter-based per sequence: every draw is keyed by
+        # (sequence seed, absolute token position) — see
+        # ops/sampling.sample_tokens_seeded. Requests without an explicit
+        # seed get one drawn here at submission; a frontend that journals
+        # for failover replay pins the seed request-side instead.
+        self._seed_rng = random.Random(seed + 1)
         self._attn_impl, self._attn_interpret = self._resolve_attn()
         # Compiled-variant caches. Decode windows are keyed by
         # (row bucket, attention impl, static page bound — None on the
@@ -244,6 +251,11 @@ class TPUEngine(AsyncEngine):
         self.wasted_steps = 0  # window steps computed past a row's stop
         self.kv_page_moves = 0  # pages moved by batched gather/scatter
         self.kv_move_dispatches = 0  # batched-move dispatches issued
+        # KV handoff leases: confirmations arrive from asyncio threads
+        # (the prefill worker's delivery ack) but the page manager is
+        # single-writer — queue them for the loop thread, which also
+        # runs the expiry reaper each iteration.
+        self._lease_confirm_q: queue.Queue[str] = queue.Queue()
 
     # ----------------------------------------------------------- compiled fns
     def _resolve_attn(self) -> tuple[str, bool]:
@@ -372,7 +384,7 @@ class TPUEngine(AsyncEngine):
 
             @partial(jax.jit, donate_argnums=(1, 2, 8))
             def decode_window(params, k, v, tokens, positions, max_pos,
-                              page_table, rng, counts_all, slot_map, temp,
+                              page_table, seeds, counts_all, slot_map, temp,
                               top_k, top_p, freq_pen, pres_pen, rep_pen,
                               stop_set, eos_gate, budget_gate):
                 # Compaction: penalty rows live slot-indexed in the
@@ -381,15 +393,19 @@ class TPUEngine(AsyncEngine):
                 counts0 = counts_all[slot_map]
 
                 def step(carry, t):
-                    tokens, positions, k, v, rng, counts = carry
+                    tokens, positions, k, v, counts = carry
                     logits, k, v = run_forward(
                         params, tokens, positions, page_table, k, v
                     )
                     shaped = apply_penalties(
                         logits, counts, freq_pen, pres_pen, rep_pen
                     )
-                    rng2, sub = jax.random.split(rng)
-                    next_tok = sample_tokens(shaped, sub, temp, top_k, top_p)
+                    # Counter-based draw keyed by (seed, fed position):
+                    # deterministic replay across instances/windows, the
+                    # property resumable streams rebuild state from.
+                    next_tok = sample_tokens_seeded(
+                        shaped, seeds, positions, temp, top_k, top_p
+                    )
                     # OpenAI logprobs: of the MODEL distribution (raw
                     # logits, pre-penalty/temperature), chosen + top-k.
                     # Compiled only into the want_lp variant — the common
@@ -411,16 +427,16 @@ class TPUEngine(AsyncEngine):
                         if want_lp
                         else (next_tok,)
                     )
-                    return (tokens, positions, k, v, rng2, counts), ys
+                    return (tokens, positions, k, v, counts), ys
 
-                (tokens, positions, k, v, rng, counts), ys = jax.lax.scan(
-                    step, (tokens, positions, k, v, rng, counts0),
+                (tokens, positions, k, v, counts), ys = jax.lax.scan(
+                    step, (tokens, positions, k, v, counts0),
                     jnp.arange(K),
                 )
                 counts_all = counts_all.at[slot_map].set(counts)
                 # ys: toks [K,rows] (+ lp [K,rows], top_ids/top_lp
                 # [K,rows,N] when want_lp).
-                return ys, k, v, rng, counts_all, tokens, positions
+                return ys, k, v, counts_all, tokens, positions
 
         else:
 
@@ -466,18 +482,26 @@ class TPUEngine(AsyncEngine):
         mcfg = self.cfg.model
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_step(params, k, v, tokens, positions, page_table, rng,
+        def prefill_step(params, k, v, tokens, positions, page_table, seeds,
                          last_idx, temp, top_k, top_p):
             logits, k, v = forward(
                 params, mcfg, tokens, positions, page_table, k, v,
                 attn_pages=attn_pages, last_positions=last_idx,
             )
-            rng, sub = jax.random.split(rng)
-            toks = sample_tokens(logits[:, 0], sub, temp, top_k, top_p)
+            # Key the first-token draw by the absolute position of the
+            # prompt's last token — identical to the draw a decode window
+            # would make feeding that token, so prefill chunking and
+            # continuation re-prefills replay the same sample.
+            last_pos = jnp.take_along_axis(
+                positions, last_idx[:, None], axis=1
+            )[:, 0]
+            toks = sample_tokens_seeded(
+                logits[:, 0], seeds, last_pos, temp, top_k, top_p
+            )
             if want_lp:
                 lp, top_ids, top_lp = token_logprobs(logits[:, 0], toks)
-                return (toks, lp, top_ids, top_lp), k, v, rng
-            return (toks,), k, v, rng
+                return (toks, lp, top_ids, top_lp), k, v
+            return (toks,), k, v
 
         self._prefill_fns[key] = prefill_step
         return prefill_step
@@ -549,6 +573,7 @@ class TPUEngine(AsyncEngine):
             remote_kv=remote_kv,
             trace=current_trace(),
             submitted_at=time.time(),
+            sample_seed=self._effective_seed(binput),
         )
         self._submit_q.put(seq)
         self._wake.set()
@@ -575,18 +600,30 @@ class TPUEngine(AsyncEngine):
 
         return ResponseStream(_gen(), ctx)
 
+    def _effective_seed(self, binput: BackendInput) -> int:
+        """The request's pinned sampling seed, or one drawn now. With a
+        pinned seed (journaling frontends always pin one for sampled
+        requests), the whole token stream is a pure function of
+        (weights, prompt, sampling params) — replayable anywhere."""
+        s = binput.sampling_options.seed
+        return int(s) if s is not None else self._seed_rng.getrandbits(31)
+
     async def prefill_extract(
         self,
         request: dict | BackendInput,
         context: AsyncEngineContext | None = None,
-    ) -> tuple[int, list]:
-        """Run prefill only and hand back (first_token, kv_pages).
+    ) -> tuple[int, list, str]:
+        """Run prefill only; hand back (first_token, kv_pages, lease_id).
 
         This is the prefill-worker side of disaggregation: the prompt's
         KV pages (host-bounced numpy, one (k, v) pair per page) travel to
         the decode worker, which injects them via ``generate(...,
         remote_kv=...)``. The pages also stay registered locally, so
-        repeated prompts prefix-hit this worker's pool.
+        repeated prompts prefix-hit this worker's pool. Until the caller
+        confirms delivery (:meth:`confirm_kv_lease`) — or the lease TTL
+        passes and the reaper reclaims them — the device pages stay
+        pinned, so a decode worker that dies between extract and inject
+        can never strand HBM.
         """
         if not self._running:
             self.start()
@@ -600,9 +637,9 @@ class TPUEngine(AsyncEngine):
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
 
-        def extract_cb(token: int, pages: list) -> None:
+        def extract_cb(token: int, pages: list, lease_id: str) -> None:
             loop.call_soon_threadsafe(
-                lambda: fut.done() or fut.set_result((token, pages))
+                lambda: fut.done() or fut.set_result((token, pages, lease_id))
             )
 
         def emit(
@@ -623,10 +660,17 @@ class TPUEngine(AsyncEngine):
             extract_cb=extract_cb,
             trace=current_trace(),
             submitted_at=time.time(),
+            sample_seed=self._effective_seed(binput),
         )
         self._submit_q.put(seq)
         self._wake.set()
         return await fut
+
+    def confirm_kv_lease(self, lease_id: str) -> None:
+        """Delivery ack for an extract lease (thread-safe: queues the
+        confirm for the engine loop, the page manager's single writer)."""
+        self._lease_confirm_q.put(lease_id)
+        self._wake.set()
 
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
@@ -647,6 +691,12 @@ class TPUEngine(AsyncEngine):
         happens only when no unconsumed window could write to them."""
         try:
             while self._running:
+                # Lease bookkeeping first: confirmations queued by the
+                # prefill worker's delivery ack, then the expiry reaper
+                # (orphaned handoffs whose decode instance died). Both
+                # mutate the page manager, so they run here — its single
+                # writer — every iteration, busy or idle.
+                self._service_leases()
                 if self._inflight is not None:
                     # Steady state: launch the next window device-to-
                     # device, then consume the previous one while the
@@ -750,6 +800,25 @@ class TPUEngine(AsyncEngine):
         if now - self._last_gauge_pub >= 0.5:
             self._last_gauge_pub = now
             get_telemetry().publish_engine_gauges(self.metrics())
+
+    def _service_leases(self) -> None:
+        """Engine-loop-thread lease upkeep: apply queued delivery
+        confirmations, then reap expired handoff leases so a decode
+        instance dying between extract and inject returns the pinned
+        pages within one lease period."""
+        while True:
+            try:
+                self.kv.confirm_lease(self._lease_confirm_q.get_nowait())
+            except queue.Empty:
+                break
+        if self.kv.active_leases:
+            reclaimed = self.kv.reap_expired()
+            if reclaimed:
+                get_telemetry().kv_lease_reclaims.inc(reclaimed)
+                log.warning(
+                    "reaped %d KV pages from expired handoff leases "
+                    "(decode side never confirmed delivery)", reclaimed,
+                )
 
     def _drain_submissions(self) -> None:
         while True:
@@ -888,39 +957,59 @@ class TPUEngine(AsyncEngine):
             prompt_tokens=len(seq.prompt),
             cached_tokens=seq.cached_len,
             remote=seq.remote_prefilled or None,
+            resumed_tokens=seq.stop.resume_offset or None,
         )
         seq.state = SeqState.ACTIVE
         self._counts = self._init_row(self._counts, seq.slot, token)
+        resumed = seq.stop.resume_offset or 0
+        if resumed and self._needs_sampler(seq):
+            # Failover continuation with penalties: the re-prefilled tail
+            # of token_ids is journaled *completion* tokens — rebuild the
+            # penalty counts from it so every post-splice decode draw
+            # sees the counts the uninterrupted run would have. (The
+            # splice token itself was just sampled by prefill, which
+            # reads the raw model distribution — see the documented
+            # caveat in docs/fault_tolerance.md.)
+            V = self.cfg.model.vocab_size
+            vec = np.zeros(V, np.int32)
+            tail = np.clip(np.asarray(seq.prompt[-resumed:], np.int64), 0, V - 1)
+            np.add.at(vec, tail, 1)
+            self._counts = self._counts.at[seq.slot].add(jnp.asarray(vec))
         seq.tokens.append(token)
         seq.generated = 1
         self.sched.register_full_pages(seq)
         if seq.extract_cb is not None:
-            seq.extract_cb(token, self._extract_prompt_pages(seq))
+            pages, lease_id = self._extract_prompt_pages(seq)
+            seq.extract_cb(token, pages, lease_id)
         reason = self.sched.check_stop(seq, token)
         seq.emit([token], None, lp_pack)
         if reason is not None:
             self.sched.finish(seq, reason)
 
-    def _extract_prompt_pages(self, seq: Sequence) -> list:
+    def _extract_prompt_pages(self, seq: Sequence) -> tuple[list, str]:
         """Host-bounce every prompt page (incl. the partial tail) for the
         disaggregation handoff: ONE batched gather dispatch and ONE host
         sync per sequence. Runs on the engine loop thread: the prefill
-        worker's job is exactly this transfer."""
+        worker's job is exactly this transfer. The device pages are
+        pinned under a handoff lease (granted here, while the sequence
+        still holds its refs) until the caller confirms delivery or the
+        reaper reclaims them."""
         ps = self.cfg.page_size
         n_pages = (len(seq.prompt) + ps - 1) // ps
         pids = seq.page_ids[:n_pages]
         if not pids:
-            return []
+            return [], ""
         k_b, v_b = self._gather_page_batch(pids)
         k_np, v_np = np.asarray(k_b), np.asarray(v_b)  # the one sync
         get_telemetry().kv_page_moves.labels("extract").inc(len(pids))
+        lease_id = self.kv.grant_lease(pids, self.cfg.kv_lease_ttl_s)
         return [
             (
                 np.ascontiguousarray(k_np[:, i]),
                 np.ascontiguousarray(v_np[:, i]),
             )
             for i in range(len(pids))
-        ]
+        ], lease_id
 
     def _run_remote_inject(self, seq: Sequence) -> None:
         """Disaggregated admission: prompt KV was computed by a remote
@@ -964,6 +1053,7 @@ class TPUEngine(AsyncEngine):
         positions = np.full((rows, bucket), -1, np.int32)
         table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
         last_idx = np.zeros(rows, np.int32)
+        seeds = np.zeros(rows, np.int32)
         temp = np.zeros(rows, np.float32)
         top_k = np.zeros(rows, np.int32)
         top_p = np.ones(rows, np.float32)
@@ -980,6 +1070,7 @@ class TPUEngine(AsyncEngine):
             if seq.prefill_sent == len(seq.prompt):
                 completed.append((i, seq))
             so = seq.stop.sampling_options
+            seeds[i] = seq.sample_seed & 0x7FFFFFFF
             temp[i] = so.temperature if so.temperature is not None else 0.0
             top_k[i] = so.top_k or 0
             top_p[i] = so.top_p if so.top_p is not None else 1.0
@@ -992,14 +1083,14 @@ class TPUEngine(AsyncEngine):
         )
         fn = self._prefill_fn(rows, bucket, attn_pages, want_lp)
         self._flush_offloads()
-        ys, self.k_cache, self.v_cache, self._rng = fn(
+        ys, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
             self.v_cache,
             jnp.asarray(tokens),
             jnp.asarray(positions),
             jnp.asarray(table),
-            self._rng,
+            jnp.asarray(seeds),
             jnp.asarray(last_idx),
             jnp.asarray(temp),
             jnp.asarray(top_k),
@@ -1120,6 +1211,7 @@ class TPUEngine(AsyncEngine):
         stop_set = np.full((rows, S), -1, np.int32)
         eos_gate = np.zeros(rows, np.int32)
         budget_gate = np.full(rows, K, np.int32)  # pad: never fires
+        seeds = np.zeros(rows, np.int32)
         temp = np.zeros(rows, np.float32)
         top_k = np.zeros(rows, np.int32)
         top_p = np.ones(rows, np.float32)
@@ -1142,6 +1234,7 @@ class TPUEngine(AsyncEngine):
             stop_set[r, : len(stops)] = stops
             eos_gate[r], budget_gate[r] = self._stop_gates(seq, seq.generated)
             so = seq.stop.sampling_options
+            seeds[r] = seq.sample_seed & 0x7FFFFFFF
             temp[r] = so.temperature if so.temperature is not None else 0.0
             top_k[r] = so.top_k or 0
             top_p[r] = so.top_p if so.top_p is not None else 1.0
@@ -1157,14 +1250,14 @@ class TPUEngine(AsyncEngine):
             rows, cfg.page_bucket_for(max_pages), full_sampler, want_lp
         )
         self._flush_offloads()
-        sampler_args = (temp, top_k, top_p, freq, pres, rep)
+        sampler_args = (seeds, temp, top_k, top_p, freq, pres, rep)
         if full_sampler:
-            (ys, self.k_cache, self.v_cache, self._rng, self._counts,
+            (ys, self.k_cache, self.v_cache, self._counts,
              tok_dev, pos_dev) = fn(
                 self.params, self.k_cache, self.v_cache,
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(max_pos), jnp.asarray(table),
-                self._rng, self._counts, jnp.asarray(slot_map),
+                jnp.asarray(seeds), self._counts, jnp.asarray(slot_map),
                 jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
                 jnp.asarray(freq), jnp.asarray(pres), jnp.asarray(rep),
                 jnp.asarray(stop_set), jnp.asarray(eos_gate),
@@ -1274,13 +1367,13 @@ class TPUEngine(AsyncEngine):
         )
         self._flush_offloads()
         if pending.full_sampler:
-            temp, top_k, top_p, freq, pres, rep = pending.sampler_args
-            (ys, self.k_cache, self.v_cache, self._rng, self._counts,
+            seeds, temp, top_k, top_p, freq, pres, rep = pending.sampler_args
+            (ys, self.k_cache, self.v_cache, self._counts,
              tok_dev, pos_dev) = fn(
                 self.params, self.k_cache, self.v_cache,
                 pending.tokens_dev, pending.positions_dev,
                 jnp.asarray(max_pos), jnp.asarray(table),
-                self._rng, self._counts, jnp.asarray(pending.slot_map),
+                jnp.asarray(seeds), self._counts, jnp.asarray(pending.slot_map),
                 jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
                 jnp.asarray(freq), jnp.asarray(pres), jnp.asarray(rep),
                 jnp.asarray(stop_set), jnp.asarray(eos_gate),
@@ -1389,6 +1482,8 @@ class TPUEngine(AsyncEngine):
         m["decode_wasted_steps"] = self.wasted_steps
         m["kv_page_moves"] = self.kv_page_moves
         m["kv_move_dispatches"] = self.kv_move_dispatches
+        m["kv_leases_active"] = self.kv.active_leases
+        m["kv_lease_reclaimed_pages"] = self.kv.lease_reclaimed_pages
         m["compiled_decode_variants"] = len(self._decode_fns)
         m["compiled_prefill_variants"] = len(self._prefill_fns)
         if self.host_pool is not None:

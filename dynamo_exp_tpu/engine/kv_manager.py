@@ -22,6 +22,7 @@ G1/G2 tiers), redesigned for the TPU engine:
 from __future__ import annotations
 
 import time
+import uuid
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Sequence
@@ -59,6 +60,28 @@ class Allocation:
 
 
 @dataclass
+class KvLease:
+    """A pin on extracted pages during a disaggregation KV handoff.
+
+    The prefill worker extracts a sequence's pages for the wire while
+    the owning sequence finishes — without a lease the pages would park
+    in the reclaimable LRU and could be evicted (or, under the handoff
+    contract, be considered delivered) before the decode worker confirms
+    receipt. The lease takes one extra reference per page; delivery
+    confirmation (``confirm_lease``) releases it, and the reaper
+    (``reap_expired``) reclaims orphans when the decode instance dies
+    between extract and inject — so failover never strands HBM.
+
+    State machine (docs/fault_tolerance.md "Resumable streams"):
+    GRANTED → CONFIRMED (transfer acked end-to-end) | EXPIRED (reaped).
+    """
+
+    lease_id: str
+    page_ids: list[int]
+    expires_at: float  # manager-clock seconds
+
+
+@dataclass
 class KvEvent:
     """Stored/removed notification for the router's radix index."""
 
@@ -83,10 +106,12 @@ class KvPageManager:
         event_cb: Callable[[KvEvent], None] | None = None,
         host_pool: "HostKvPool | None" = None,
         on_evict: Callable[[int, int], None] | None = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self.num_pages = num_pages
         self.page_size = page_size
         self.event_cb = event_cb
+        self.clock = clock
         # G2 tier: evicted device pages are offloaded (via ``on_evict``,
         # which the engine wires to a device gather + CopyStream) and
         # matched back in from ``host_pool`` on later prompts.
@@ -100,6 +125,10 @@ class KvPageManager:
         self._by_hash: dict[int, int] = {}
         # Zero-ref registered pages, LRU order (oldest first).
         self._reclaimable: OrderedDict[int, None] = OrderedDict()
+        # Disaggregation handoff leases, by lease id (single-writer like
+        # everything else here: only the engine loop thread touches them).
+        self._leases: dict[str, KvLease] = {}
+        self.lease_reclaimed_pages = 0  # pages freed by the reaper
         # Metrics counters.
         self.hits = 0
         self.misses = 0
@@ -269,6 +298,51 @@ class KvPageManager:
                     self._reclaimable.move_to_end(pid)
                 else:
                     self._free.append(pid)
+
+    # ---------------------------------------------------------------- leases
+    @property
+    def active_leases(self) -> int:
+        return len(self._leases)
+
+    def grant_lease(self, page_ids: Sequence[int], ttl_s: float) -> str:
+        """Pin ``page_ids`` (one extra ref each) for a KV handoff in
+        flight; returns the lease id the wire protocol carries. Must be
+        called while the pages are still referenced (before the owning
+        sequence is released), i.e. on the engine loop thread."""
+        for pid in page_ids:
+            self._ref_page(pid)
+        lease = KvLease(
+            lease_id=uuid.uuid4().hex,
+            page_ids=list(page_ids),
+            expires_at=self.clock() + ttl_s,
+        )
+        self._leases[lease.lease_id] = lease
+        return lease.lease_id
+
+    def confirm_lease(self, lease_id: str) -> bool:
+        """Delivery confirmed: drop the lease's pins. Registered pages
+        park in the reclaimable LRU exactly as a finished sequence's
+        would. Unknown/already-reaped ids are a no-op (the confirm raced
+        the reaper)."""
+        lease = self._leases.pop(lease_id, None)
+        if lease is None:
+            return False
+        self.release_sequence(lease.page_ids)
+        return True
+
+    def reap_expired(self, now: float | None = None) -> int:
+        """Reclaim every expired lease's pages; returns pages freed.
+        Engine-loop-thread only (mutates the free lists)."""
+        now = self.clock() if now is None else now
+        reclaimed = 0
+        for lid in [
+            lid for lid, l in self._leases.items() if now >= l.expires_at
+        ]:
+            lease = self._leases.pop(lid)
+            self.release_sequence(lease.page_ids)
+            reclaimed += len(lease.page_ids)
+        self.lease_reclaimed_pages += reclaimed
+        return reclaimed
 
     # -------------------------------------------------------------- internal
     def _available_for_take(self) -> int:

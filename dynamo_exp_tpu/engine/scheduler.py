@@ -107,6 +107,12 @@ class Sequence:
     last_emit_at: float = 0.0
     # Set when the prompt KV was injected from a remote prefill worker.
     remote_prefilled: bool = False
+    # Effective sampling seed (request's sampling_options.seed, or one
+    # the engine drew at submission). Sampling is counter-based per row —
+    # every draw is keyed by (sample_seed, absolute token position) — so
+    # a request replayed with the same seed reproduces its tokens on any
+    # instance, any batch shape (the failover-replay guarantee).
+    sample_seed: int = 0
 
     @property
     def pos(self) -> int:

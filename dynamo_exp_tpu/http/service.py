@@ -28,8 +28,8 @@ from ..preprocessor.preprocessor import InvalidRequestError, PromptTooLongError
 from ..protocols.sse import encode_done, encode_frame
 from ..runtime.annotated import Annotated
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, DeadlineExceededError
-from ..runtime.push_router import NoInstancesError
-from ..telemetry import span
+from ..runtime.push_router import NoInstancesError, RecoveryExhaustedError
+from ..telemetry import get_telemetry, span
 from .metrics import CONTENT_TYPE_LATEST, ServiceMetrics
 
 # Clients hint how soon to retry a 503 (no instances / breaker open):
@@ -246,6 +246,14 @@ class HttpService:
 
             async def _typed_chunks():
                 for idx, stream in enumerate(streams):
+                    # Resumable-stream belt-and-braces: chunks carry a
+                    # cumulative sequence index (``seq_index``); anything
+                    # at or below the emitted watermark is a replayed
+                    # duplicate from a mid-stream failover splice and is
+                    # dropped here, so the client-facing SSE stream is
+                    # duplicate-free even if a lower layer misbehaves.
+                    high = 0  # emitted watermark (cumulative tokens)
+                    last = 0  # previous chunk's index, arrival order
                     async for item in stream:
                         if streaming:
                             tracker.first_token()
@@ -254,6 +262,15 @@ class HttpService:
                             if isinstance(item, dict)
                             else item
                         )
+                        si = getattr(chunk, "seq_index", None)
+                        if si is not None:
+                            if si <= high:
+                                get_telemetry().tokens_deduplicated.inc(
+                                    max(si - last, 0)
+                                )
+                                last = si
+                                continue
+                            last = high = si
                         if idx and chunk.choices:
                             for choice in chunk.choices:
                                 choice.index = idx
@@ -262,6 +279,17 @@ class HttpService:
             if not req.stream:
                 try:
                     full = await aggregate(_typed_chunks())
+                except RecoveryExhaustedError as e:
+                    # A resumable stream broke more times than
+                    # max_recoveries allows: the upstream fleet kept
+                    # dying mid-generation — a gateway failure, not a
+                    # client error and not "no instances".
+                    tracker.status = "recovery_exhausted"
+                    root.set(status="recovery_exhausted")
+                    ctx.kill()
+                    return _error_response(
+                        502, str(e), err_type="bad_gateway"
+                    )
                 except NoInstancesError as e:
                     tracker.status = "unavailable"
                     root.set(status="unavailable")

@@ -123,11 +123,29 @@ class KvPushRouter(AsyncEngine):
     Failover stays KV-aware: a connection/stream-start failure re-runs
     the selector over the remaining workers (failed + unhealthy +
     draining excluded) instead of falling back to random choice, so the
-    retry still lands on the best surviving prefix overlap."""
+    retry still lands on the best surviving prefix overlap. Mid-stream
+    failover (resumable streams) re-selects the same way — the
+    continuation's token_ids include the journaled tokens, so the
+    overlap estimate prices the re-prefill correctly."""
 
     def __init__(self, push_router: PushRouter, kv_router: KvRouter):
         self.push = push_router
         self.kv = kv_router
+        # Install the KV-aware re-selector for mid-stream continuation
+        # dispatch (PushRouter alone would refuse to move an
+        # explicit-target request to a different instance).
+        self.push.continuation_selector = self._reselect
+
+    async def _reselect(
+        self, token_ids: list[int], exclude: frozenset[int]
+    ) -> int:
+        try:
+            resp = await self.kv.schedule(
+                token_ids, exclude=set(exclude) | self.push.unavailable_ids()
+            )
+        except NoWorkersError as e:
+            raise NoHealthyInstancesError(str(e)) from e
+        return resp.worker_id
 
     async def generate(
         self, request: dict | Any, context: AsyncEngineContext | None = None

@@ -16,17 +16,14 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_tokens(
+def _mask_logits(
     logits: jnp.ndarray,  # [B, V] float32
-    rng: jax.Array,  # PRNG key
-    temperature: jnp.ndarray,  # [B] float32; <=0 means greedy
+    temperature: jnp.ndarray,  # [B] float32
     top_k: jnp.ndarray,  # [B] int32; <=0 disables
     top_p: jnp.ndarray,  # [B] float32; >=1 disables
 ) -> jnp.ndarray:
-    """Returns sampled token ids [B] int32."""
+    """Temperature-scaled logits with top-k/top-p mass masked to -inf."""
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 
@@ -52,9 +49,51 @@ def sample_tokens(
     min_kept = jnp.min(
         jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
     )
-    masked = jnp.where(scaled < min_kept, -jnp.inf, masked)
+    return jnp.where(scaled < min_kept, -jnp.inf, masked)
 
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    rng: jax.Array,  # PRNG key
+    temperature: jnp.ndarray,  # [B] float32; <=0 means greedy
+    top_k: jnp.ndarray,  # [B] int32; <=0 disables
+    top_p: jnp.ndarray,  # [B] float32; >=1 disables
+) -> jnp.ndarray:
+    """Returns sampled token ids [B] int32 (shared-key batch draw)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = _mask_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def sample_tokens_seeded(
+    logits: jnp.ndarray,  # [B, V] float32
+    seeds: jnp.ndarray,  # [B] int32 per-row sampling seed
+    positions: jnp.ndarray,  # [B] int32 absolute position of the fed token
+    temperature: jnp.ndarray,  # [B] float32; <=0 means greedy
+    top_k: jnp.ndarray,  # [B] int32; <=0 disables
+    top_p: jnp.ndarray,  # [B] float32; >=1 disables
+) -> jnp.ndarray:
+    """Counter-based per-row sampling: row ``r``'s draw depends only on
+    ``(seeds[r], positions[r])`` and its own logits — independent of
+    batch composition, decode-window layout, prefill chunking, and which
+    engine instance runs the step. That independence is the determinism
+    guarantee mid-stream failover replay relies on (docs/
+    fault_tolerance.md "Resumable streams"): re-prefill the same tokens
+    on any healthy worker, and the continuation samples the exact draws
+    the dead worker would have."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked = _mask_logits(logits, temperature, top_k, top_p)
+
+    def draw(row_logits, seed, pos):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        return jax.random.categorical(key, row_logits)
+
+    # Inactive rows carry position -1; clamp so fold_in sees a valid
+    # counter (their draw is discarded anyway).
+    sampled = jax.vmap(draw)(
+        masked, seeds, jnp.maximum(positions, 0)
+    ).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 

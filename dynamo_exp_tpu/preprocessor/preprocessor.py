@@ -197,7 +197,12 @@ class OpenAIPreprocessor(Operator):
                     tops = out.top_logprobs or [None] * len(out.logprobs)
                     pending += list(zip(out.token_ids, out.logprobs, tops))
                 if out.text:
-                    yield gen.text_chunk(out.text, _shape(pending))
+                    chunk = gen.text_chunk(out.text, _shape(pending))
+                    # Sequence-index the chunk (cumulative token count)
+                    # so the SSE layer can prove the stream gap-free and
+                    # duplicate-free across mid-stream failovers.
+                    chunk.seq_index = completion_tokens
+                    yield chunk
                     pending = []
                 if out.finish_reason is not None:
                     finish = FinishReason(out.finish_reason)

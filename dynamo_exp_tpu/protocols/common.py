@@ -73,6 +73,13 @@ class BackendInput(BaseModel):
     # Disaggregation: set when a remote prefill worker already computed the
     # prompt's KV; the decode engine skips prefill for those blocks.
     remote_prefill: dict[str, Any] | None = None
+    # Resumable streams: this request is a mid-stream failover
+    # continuation and the last ``resume_offset`` entries of
+    # ``token_ids`` are journaled *completion* tokens being re-prefilled,
+    # not prompt. Engines treat the request normally (one batched prefill
+    # over the whole sequence); the field marks the re-prefill hop for
+    # telemetry and accounting — the journaling router owns usage fixup.
+    resume_offset: int | None = None
 
     def to_dict(self) -> dict:
         return self.model_dump(exclude_none=True)

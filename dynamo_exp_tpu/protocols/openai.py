@@ -189,6 +189,11 @@ class ChatCompletionChunk(BaseModel):
     model: str
     choices: list[ChatStreamChoice]
     usage: Usage | None = None
+    # Extension (like nvext): cumulative completion-token count through
+    # this chunk. Monotonically increasing within one stream — the SSE
+    # layer's dedup key for resumable streams (absent on token-free
+    # chunks and for engines that don't count tokens).
+    seq_index: int | None = None
 
 
 class ChatChoice(BaseModel):
@@ -221,6 +226,9 @@ class CompletionChunk(BaseModel):
     model: str
     choices: list[CompletionChoice]
     usage: Usage | None = None
+    # Extension: cumulative completion-token count through this chunk
+    # (see ChatCompletionChunk.seq_index).
+    seq_index: int | None = None
 
 
 class CompletionResponse(CompletionChunk):

@@ -20,6 +20,7 @@ from .engine import (
     ResponseStream,
 )
 from .health import BreakerState, CircuitBreaker, HealthTracker, is_draining
+from .journal import ReplayJournal
 from .logging import configure_logging
 from .pipeline import (
     Context,
@@ -39,6 +40,7 @@ from .push_router import (
     NoHealthyInstancesError,
     NoInstancesError,
     PushRouter,
+    RecoveryExhaustedError,
     RouterMode,
 )
 from .runtime import CancellationToken, Runtime, Worker
@@ -74,6 +76,8 @@ __all__ = [
     "Pool",
     "PoolItem",
     "PushRouter",
+    "RecoveryExhaustedError",
+    "ReplayJournal",
     "ResponseStream",
     "RouterMode",
     "Runtime",

@@ -152,6 +152,34 @@ class Client:
 
         return _gen()
 
+    async def open_stream(
+        self,
+        instance: InstanceInfo,
+        request: dict,
+        context: AsyncEngineContext | None = None,
+    ) -> tuple[Annotated | None, AsyncIterator[Annotated]]:
+        """Dispatch and eagerly pull the stream's first frame, so
+        stream-start failures surface to the caller's retry loop as
+        exceptions *here* rather than mid-iteration.
+
+        Returns ``(first, rest)``; ``first`` is None for a clean empty
+        stream. An in-band error in the first frame is *returned* (not
+        raised): it means the stream started — an application failure,
+        outside the failover contract. The push router uses this for
+        both the initial dispatch and resumable-stream continuation
+        re-dispatches."""
+        frames = await self.generate_to(instance, request, context)
+        try:
+            first: Annotated | None = await anext(aiter(frames))
+        except StopAsyncIteration:
+            first = None
+        except EngineError as e:
+            # generate_to raises for error frames; fold the first-frame
+            # case back into a frame so retry loops' ConnectionError
+            # filters stay precise.
+            first = Annotated.from_error(str(e))
+        return first, frames
+
     def close(self) -> None:
         if self._watch_task is not None:
             self._watch_task.cancel()

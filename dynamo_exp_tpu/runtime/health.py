@@ -102,6 +102,18 @@ class CircuitBreaker:
             return self.clock() - self._opened_at >= self.cooldown_s
         return not self._probe_inflight
 
+    def release(self) -> None:
+        """Release a claimed probe slot **without recording an outcome**.
+
+        Some dispatch paths end with neither success nor failure evidence
+        about the instance — the request's own deadline expired, the
+        caller was cancelled mid-await. Without this, the slot claimed by
+        ``allow()`` leaks: the breaker sticks in HALF_OPEN with
+        ``_probe_inflight`` set forever and the instance becomes
+        permanently unroutable. Callers must pair every ``allow()`` with
+        exactly one of record_success / record_failure / release."""
+        self._probe_inflight = False
+
     def record_success(self) -> None:
         self.consecutive_failures = 0
         self._probe_inflight = False
@@ -178,6 +190,12 @@ class HealthTracker:
         entry = self._entry(instance_id)
         entry.failures_total += 1
         entry.breaker.record_failure()
+
+    def release(self, instance_id: int) -> None:
+        """Outcome-free release of an :meth:`acquire` claim (deadline
+        expiry, cancellation — paths that say nothing about the
+        instance's health). See :meth:`CircuitBreaker.release`."""
+        self._entry(instance_id).breaker.release()
 
     def breaker(self, instance_id: int) -> CircuitBreaker:
         return self._entry(instance_id).breaker

@@ -12,11 +12,21 @@ breaker-blocked instances (the client's
 **connection/stream-start** failure — the transport refused, or the
 stream died before its first frame — is retried with exponential backoff
 + jitter against a *different* instance, up to ``retries`` times and
-never past the request's deadline. Once the first frame has arrived the
-stream is committed to its instance: mid-stream failures always surface
-to the caller (re-issuing could duplicate tokens). In-band error frames
-(``EngineError``) are application errors, not transport errors, and are
-never retried either.
+never past the request's deadline.
+
+**Resumable streams**: once the first frame has arrived, a break is no
+longer a retry — naive re-issue would duplicate tokens — but it is no
+longer fatal either. Journalable requests (engine-level dicts carrying
+``token_ids``) get a :class:`~dynamo_exp_tpu.runtime.journal.ReplayJournal`:
+every emitted token is recorded with its sequence index, and a mid-stream
+break (worker crash, drain exceeding its grace period) re-dispatches a
+**continuation request** — prompt + journaled tokens re-prefilled on a
+different healthy instance, budget reduced by what was delivered, seed
+pinned so the engine's counter-based sampler replays the exact draws —
+up to ``max_recoveries`` times, never past the deadline, after which
+:class:`RecoveryExhaustedError` surfaces (HTTP 502). In-band error frames
+(``EngineError``) are application errors and are never retried or
+resumed.
 """
 
 from __future__ import annotations
@@ -25,10 +35,9 @@ import asyncio
 import enum
 import itertools
 import random
-from typing import Any, AsyncIterator
+from typing import Any, AsyncIterator, Awaitable, Callable
 
-from ..telemetry import get_telemetry
-from .annotated import Annotated
+from ..telemetry import get_telemetry, span as trace_span
 from .client import Client
 from .engine import (
     AsyncEngine,
@@ -36,6 +45,7 @@ from .engine import (
     DeadlineExceededError,
     ResponseStream,
 )
+from .journal import ReplayJournal
 from .transports.base import InstanceInfo
 
 
@@ -56,6 +66,11 @@ class NoHealthyInstancesError(NoInstancesError):
     already tried this request — the 503 + Retry-After case."""
 
 
+class RecoveryExhaustedError(ConnectionError):
+    """A resumable stream broke more than ``max_recoveries`` times (or
+    past its deadline); the HTTP layer maps this to 502."""
+
+
 class PushRouter(AsyncEngine[dict, Any]):
     """Routes each request to one live instance of a remote endpoint."""
 
@@ -68,6 +83,10 @@ class PushRouter(AsyncEngine[dict, Any]):
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         rng: random.Random | None = None,
+        max_recoveries: int = 2,
+        continuation_selector: (
+            Callable[[list[int], frozenset[int]], Awaitable[int]] | None
+        ) = None,
     ):
         self.client = client
         self.mode = mode
@@ -78,8 +97,14 @@ class PushRouter(AsyncEngine[dict, Any]):
         self.retries = retries
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
-        # Injectable rng keeps backoff jitter deterministic under test.
+        # Injectable rng keeps backoff jitter (and journal seed pinning)
+        # deterministic under test.
         self.rng = rng or random.Random()
+        # Mid-stream failover budget per request; 0 disables journaling.
+        self.max_recoveries = max_recoveries
+        # KV-aware wrappers install a re-selector so a continuation still
+        # lands on the best surviving prefix overlap (KvPushRouter).
+        self.continuation_selector = continuation_selector
         self._rr = itertools.count()
 
     @property
@@ -141,6 +166,68 @@ class PushRouter(AsyncEngine[dict, Any]):
         if delay > 0:
             await asyncio.sleep(delay)
 
+    async def _dispatch(
+        self,
+        request: dict,
+        ctx: AsyncEngineContext,
+        tried: set[int],
+        pick: Callable[[], Awaitable[InstanceInfo] | InstanceInfo],
+        retry_ok: bool,
+    ):
+        """One health-guarded dispatch loop: pick → acquire → open the
+        stream, retrying stream-start failures against other instances.
+        Every ``health.acquire`` is paired with exactly one of
+        record_success / record_failure / release — a CancelledError (or
+        any non-transport error) escaping between acquire and outcome
+        must not strand the half-open probe slot (ROADMAP open item)."""
+        attempt = 0
+        while True:
+            ctx.check_deadline("router")
+            instance = pick()
+            if asyncio.iscoroutine(instance):
+                instance = await instance
+            self.health.acquire(instance.instance_id)
+            try:
+                first, frames = await self.client.open_stream(
+                    instance, request, ctx
+                )
+            except ConnectionError as e:
+                # Stream-start failure: the instance never produced a
+                # frame, so failing over cannot duplicate output.
+                self.health.record_failure(instance.instance_id)
+                tried.add(instance.instance_id)
+                attempt += 1
+                if not retry_ok or attempt > self.retries:
+                    raise
+                get_telemetry().request_retries.labels(
+                    "connect" if _is_connect_error(e) else "stream_start"
+                ).inc()
+                await self.sleep_backoff(attempt, ctx)
+                continue
+            except BaseException:
+                # No transport outcome (cancellation, bugs, deadline
+                # races): free the probe slot without judging health.
+                self.health.release(instance.instance_id)
+                raise
+            if (
+                first is not None
+                and first.is_error()
+                and ctx.deadline_expired
+            ):
+                # The deadline expired in transit and the remote plane
+                # refused in-band. That is neither an instance failure
+                # nor an application error — surface it as the deadline
+                # it is (HTTP maps this to 504, not 500). The probe slot
+                # is released outcome-free: the expiry says nothing
+                # about this instance's health.
+                self.health.release(instance.instance_id)
+                raise DeadlineExceededError(
+                    first.error_message()
+                    or f"request {ctx.id} deadline exceeded at request plane"
+                )
+            self.health.record_success(instance.instance_id)
+            return instance, first, frames
+
     async def generate(
         self, request: dict, context: AsyncEngineContext | None = None
     ) -> ResponseStream[Any]:
@@ -152,57 +239,147 @@ class PushRouter(AsyncEngine[dict, Any]):
                 pass  # fall through to the strict error below
         explicit_target = "_worker_instance_id" in request
         clean = {k: v for k, v in request.items() if k != "_worker_instance_id"}
+        # Journal for mid-stream failover — engine-level requests only.
+        # With an explicit target, recovery needs a re-selector (the
+        # KV-aware wrapper's), otherwise the target is contractual.
+        journal = None
+        if self.max_recoveries > 0 and (
+            not explicit_target or self.continuation_selector is not None
+        ):
+            journal = ReplayJournal.for_request(clean, self.rng)
+        if journal is not None:
+            clean = journal.request  # the seed-pinned copy
         tried: set[int] = set()
-        attempt = 0
-        while True:
-            ctx.check_deadline("router")
-            instance = self._pick(request, exclude=tried)
-            self.health.acquire(instance.instance_id)
-            try:
-                frames = await self.client.generate_to(instance, clean, ctx)
-                first = await _pull_first(frames)
-            except ConnectionError as e:
-                # Stream-start failure: the instance never produced a
-                # frame, so failing over cannot duplicate output.
-                self.health.record_failure(instance.instance_id)
-                tried.add(instance.instance_id)
-                attempt += 1
-                if explicit_target or attempt > self.retries:
-                    raise
-                get_telemetry().request_retries.labels(
-                    "connect" if _is_connect_error(e) else "stream_start"
-                ).inc()
-                await self.sleep_backoff(attempt, ctx)
-                continue
-            if (
-                first is not None
-                and first.is_error()
-                and ctx.deadline_expired
-            ):
-                # The deadline expired in transit and the remote plane
-                # refused in-band. That is neither an instance failure
-                # nor an application error — surface it as the deadline
-                # it is (HTTP maps this to 504, not 500).
-                raise DeadlineExceededError(
-                    first.error_message()
-                    or f"request {ctx.id} deadline exceeded at request plane"
-                )
-            self.health.record_success(instance.instance_id)
-            break
+        instance, first, frames = await self._dispatch(
+            clean,
+            ctx,
+            tried,
+            pick=lambda: self._pick(request, exclude=tried),
+            retry_ok=not explicit_target,
+        )
+
+        async def _emit(data) -> AsyncIterator[Any]:
+            if journal is None:
+                yield data
+            else:
+                out = journal.record(data)
+                if out is not None:
+                    yield out
+
+        # Instances whose stream broke mid-flight for THIS request: a
+        # continuation never lands on any of them again (cumulative
+        # across recoveries, not just the most recent death).
+        broken: set[int] = set()
 
         async def _data() -> AsyncIterator[Any]:
-            if first is not None:
-                if first.is_error():
-                    from .client import EngineError
+            nonlocal instance, first, frames
+            while True:
+                try:
+                    if first is not None:
+                        if first.is_error():
+                            from .client import EngineError
 
-                    raise EngineError(first.error_message() or "remote error")
-                if first.data is not None:
-                    yield first.data
-            async for ann in frames:
-                if ann.data is not None:
-                    yield ann.data
+                            raise EngineError(
+                                first.error_message() or "remote error"
+                            )
+                        if first.data is not None:
+                            async for out in _emit(first.data):
+                                yield out
+                        first = None
+                    async for ann in frames:
+                        if ann.data is not None:
+                            async for out in _emit(ann.data):
+                                yield out
+                    return
+                except ConnectionError as e:
+                    if journal is None or journal.finished:
+                        raise
+                    done = journal.synthetic_finish()
+                    if done is not None:
+                        # The stream died between its last token and the
+                        # finish frame; the budget is spent — close the
+                        # stream locally instead of re-prefilling to
+                        # generate nothing.
+                        yield done
+                        return
+                    broken.add(instance.instance_id)
+                    instance, first, frames = await self._recover(
+                        journal, instance, e, ctx, broken
+                    )
 
         return ResponseStream(_data(), ctx)
+
+    async def _recover(
+        self,
+        journal: ReplayJournal,
+        dead: InstanceInfo,
+        err: ConnectionError,
+        ctx: AsyncEngineContext,
+        broken: set[int],
+    ):
+        """Mid-stream break: record the failure, then re-dispatch the
+        journal's continuation request to a different healthy instance —
+        never one whose stream already broke for this request
+        (``broken`` accumulates across recoveries). Bounded by
+        ``max_recoveries`` and the request's deadline."""
+        self.health.record_failure(dead.instance_id)
+        if journal.recoveries >= self.max_recoveries:
+            raise RecoveryExhaustedError(
+                f"stream for request {ctx.id} broke "
+                f"{journal.recoveries + 1} times "
+                f"(max_recoveries={self.max_recoveries}): {err}"
+            ) from err
+        if ctx.deadline_expired:
+            # No recovery after the deadline: the client has given up.
+            raise DeadlineExceededError(
+                f"request {ctx.id} deadline exceeded during mid-stream "
+                f"recovery (stream broke: {err})"
+            ) from err
+        journal.recoveries += 1
+        reason = "drain" if "drain" in str(err).lower() else "stream_drop"
+        get_telemetry().request_recoveries.labels(reason).inc()
+        cont = journal.continuation_request()
+        tried = set(broken)
+        # The recovery span marks the re-prefill hop in the request's
+        # trace timeline (`llmctl trace <id>`).
+        with trace_span(
+            "recovery",
+            request_id=ctx.id,
+            reason=reason,
+            recovery=journal.recoveries,
+            journaled_tokens=len(journal.tokens),
+            dead_instance=dead.instance_id,
+        ) as sp:
+            instance, first, frames = await self._dispatch(
+                cont,
+                ctx,
+                tried,
+                pick=lambda: self._pick_continuation(cont, tried),
+                retry_ok=True,
+            )
+            sp.set(instance_id=instance.instance_id)
+        journal.begin_continuation()
+        return instance, first, frames
+
+    def _pick_continuation(self, cont: dict, tried: set[int]):
+        """Continuation placement: the KV-aware re-selector when
+        installed (it sees prompt+journal, so the overlap estimate
+        includes the re-prefill), plain health-filtered policy pick
+        otherwise. Never the instance(s) that already failed this
+        request."""
+        if self.continuation_selector is None:
+            return self._pick(cont, exclude=tried)
+
+        async def _select() -> InstanceInfo:
+            wid = await self.continuation_selector(
+                cont.get("token_ids", []), frozenset(tried)
+            )
+            try:
+                return self.client.instance(int(wid))
+            except KeyError as e:
+                raise NoInstancesError(str(e)) from e
+
+        return _select()
 
     async def generate_direct(
         self,
@@ -213,27 +390,6 @@ class PushRouter(AsyncEngine[dict, Any]):
         return await self.generate(
             {**request, "_worker_instance_id": instance_id}, context
         )
-
-
-async def _pull_first(frames: AsyncIterator[Annotated]) -> Annotated | None:
-    """Eagerly pull the stream's first frame so stream-start failures are
-    observable inside the retry loop. Error frames are returned (not
-    raised): an in-band error means the stream *started* — it is an
-    application failure, outside the failover contract. Returns None for
-    a clean empty stream."""
-    try:
-        return await anext(aiter(frames))
-    except StopAsyncIteration:
-        return None
-    except Exception as e:
-        # Client.generate_to raises EngineError for error frames; convert
-        # the first-frame case back to a frame so the retry loop's
-        # ConnectionError filter stays precise.
-        from .client import EngineError
-
-        if isinstance(e, EngineError):
-            return Annotated.from_error(str(e))
-        raise
 
 
 def _is_connect_error(e: Exception) -> bool:

@@ -54,8 +54,11 @@ class Fault:
     ``kind``: ``error`` raises :class:`ConnectionError` (for ``request``,
     :attr:`after_frames` refines *when*: ``None`` fails the dispatch
     itself, ``N >= 0`` starts the stream and kills it after N frames —
-    the worker-crash-mid-stream shape); ``delay`` sleeps ``delay_s``
-    (plus seeded jitter) and then proceeds normally.
+    the worker-crash-mid-stream shape; :attr:`after_tokens` is the same
+    cut expressed in **tokens**: the stream dies once N tokens have been
+    delivered, counting ``len(frame.data.token_ids)`` per frame — the
+    kill-at-token-K primitive resumable-stream tests script); ``delay``
+    sleeps ``delay_s`` (plus seeded jitter) and then proceeds normally.
 
     ``times``: how many matching calls consume this fault (-1 = every
     matching call until the schedule is cleared).
@@ -65,6 +68,7 @@ class Fault:
     kind: str = "error"
     instance_id: int | None = None
     after_frames: int | None = None
+    after_tokens: int | None = None
     delay_s: float = 0.0
     times: int = 1
     message: str = "chaos: injected fault"
@@ -99,6 +103,40 @@ class ChaosSchedule:
                 message="chaos: request failed"
                 if after_frames is None
                 else "chaos: stream dropped",
+            )
+        )
+
+    def crash_at_token(
+        self, k: int, instance_id: int | None = None, times: int = 1
+    ) -> "ChaosSchedule":
+        """Kill the response stream once exactly ``k`` tokens have been
+        delivered (frames without ``token_ids`` pass through untouched) —
+        the decode-worker-dies-mid-generation shape the resumable-stream
+        suite replays at several k."""
+        return self.add(
+            Fault(
+                "request",
+                instance_id=instance_id,
+                times=times,
+                after_tokens=k,
+                message=f"chaos: decode worker crashed at token {k}",
+            )
+        )
+
+    def drain_timeout(
+        self, instance_id: int | None = None, after_tokens: int = 0, times: int = 1
+    ) -> "ChaosSchedule":
+        """A graceful drain whose grace period expires mid-stream: the
+        instance cuts the connection after ``after_tokens`` tokens
+        instead of finishing the request. Distinguished from a crash by
+        its message, so recovery telemetry labels it ``drain``."""
+        return self.add(
+            Fault(
+                "request",
+                instance_id=instance_id,
+                times=times,
+                after_tokens=after_tokens,
+                message="chaos: drain grace period exceeded mid-stream",
             )
         )
 
@@ -189,6 +227,13 @@ class ChaosRequestPlane(RequestPlane):
         if fault is not None:
             if fault.kind == "delay":
                 await self.schedule.apply_delay(fault)
+            elif fault.after_tokens is not None:
+                inner = await self.inner.request_stream(
+                    instance, request, context
+                )
+                return _drop_after_tokens(
+                    inner, fault.after_tokens, fault.message
+                )
             elif fault.after_frames is None:
                 raise ConnectionError(fault.message)
             else:
@@ -228,6 +273,34 @@ async def _drop_after(
     if produced < n:
         return  # stream ended before the scheduled crash point
     raise ConnectionError(message)
+
+
+async def _drop_after_tokens(
+    frames: AsyncIterator[dict], k: int, message: str
+) -> AsyncIterator[dict]:
+    """Yield frames until ``k`` tokens have been delivered, then die like
+    a crashed worker connection — immediately after the frame that
+    reaches the count (so a trailing finish/usage frame is lost with the
+    connection, exactly like a real crash). ``k=0`` kills before the
+    first token-bearing frame. Token counting inspects the engine-frame
+    shape (``data.token_ids``). A stream that ends before K tokens never
+    reaches its scheduled crash point (mirrors ``_drop_after``)."""
+    delivered = 0
+    async for frame in frames:
+        data = frame.get("data") if isinstance(frame, dict) else None
+        n_toks = (
+            len(data.get("token_ids") or []) if isinstance(data, dict) else 0
+        )
+        crash_before = n_toks > 0 and delivered >= k  # only when k == 0
+        if not crash_before:
+            yield frame
+            delivered += n_toks
+        if crash_before or delivered >= k:
+            closer = getattr(frames, "aclose", None)
+            if closer is not None:
+                with contextlib.suppress(Exception):
+                    await closer()
+            raise ConnectionError(message)
 
 
 class ChaosDiscovery(Discovery):

@@ -204,6 +204,25 @@ class Telemetry:
             ["event"],  # started | completed
             registry=self.registry,
         )
+        # Resumable streams (docs/fault_tolerance.md): mid-stream
+        # failovers by cause, duplicate tokens trimmed by sequence-index
+        # dedup, and HBM pages reclaimed from orphaned handoff leases.
+        self.request_recoveries = Counter(
+            "dynamo_request_recoveries_total",
+            "Mid-stream failovers resumed on a different instance",
+            ["reason"],  # stream_drop | drain
+            registry=self.registry,
+        )
+        self.tokens_deduplicated = Counter(
+            "dynamo_tokens_deduplicated_total",
+            "Duplicate-index tokens dropped while splicing a resumed stream",
+            registry=self.registry,
+        )
+        self.kv_lease_reclaims = Counter(
+            "dynamo_kv_lease_reclaims_total",
+            "KV pages reclaimed from expired disagg handoff leases",
+            registry=self.registry,
+        )
 
     # ------------------------------------------------------------ recorder
     def configure(self, trace_file: str | None) -> None:

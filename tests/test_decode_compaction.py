@@ -325,7 +325,7 @@ def test_disagg_roundtrip_190_pages_single_dispatch():
     eng_a._extract_prompt_pages = spy
     eng_a.start()
     try:
-        first_tok, pages = asyncio.run(
+        first_tok, pages, _lease = asyncio.run(
             eng_a.prefill_extract(BackendInput(token_ids=prompt).to_dict())
         )
         assert len(pages) == n_pages

@@ -384,6 +384,99 @@ async def test_short_deadline_timeout_does_not_blame_prefill_fleet():
         await recv.close()
 
 
+async def test_half_open_probe_released_on_deadline_expiry_in_decode():
+    """Regression (ROADMAP open item): a HALF_OPEN probe claimed by the
+    remote-prefill path whose wait is cut short by the *request's own
+    deadline* records neither success nor failure — it must RELEASE the
+    probe slot, or the breaker sticks in HALF_OPEN and remote prefill is
+    locked out forever. The breaker must then exit HALF_OPEN via the
+    next (successful) probe."""
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    sched = ChaosSchedule(SEEDS[0])
+    t = [0.0]
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=lambda: t[0])
+    engine, inner_queue, recv = make_disagg(
+        sched, transfer_timeout_s=60.0, breaker=breaker
+    )
+    await recv.start()
+    try:
+        breaker.record_failure()
+        assert breaker.state is BreakerState.OPEN
+        t[0] = 6.0  # cooldown over: next dispatch becomes the probe
+
+        ctx = AsyncEngineContext()
+        ctx.start_timeout(0.05)  # expires during the transfer wait
+        b = BackendInput(token_ids=list(range(3, 23)))
+        out = (await collect(await engine.generate(b.to_dict(), ctx)))[0]
+        assert out["remote"] is False  # fell back locally
+        assert breaker.state is BreakerState.HALF_OPEN
+        # THE regression: without release(), the probe slot stays claimed
+        # and no request may ever probe again.
+        assert breaker.would_allow()
+        assert await inner_queue.pull(timeout_s=0.5) is not None
+
+        # And the breaker exits HALF_OPEN on the next, successful probe.
+        service = asyncio.ensure_future(
+            fake_prefill_service(inner_queue, engine.engine.cfg)
+        )
+        out = await run_one(engine)
+        await asyncio.wait_for(service, 5)
+        assert out["remote"] is True
+        assert breaker.state is BreakerState.CLOSED
+    finally:
+        await recv.close()
+
+
+async def test_half_open_probe_released_on_cancelled_dispatch():
+    """Regression (ROADMAP open item): a CancelledError escaping between
+    ``health.acquire()`` and any outcome in the push router leaked the
+    half-open probe slot (the ConnectionError-only handler never saw
+    it). The slot must be released outcome-free and the breaker must
+    exit HALF_OPEN via the next probe."""
+    sched = ChaosSchedule(SEEDS[0])
+    drt = chaos_runtime(sched)
+    ep = drt.namespace("ft").component("worker").endpoint("generate")
+    hang = asyncio.Event()
+    served_after_hang: list = []
+
+    async def handler(request, context=None):
+        if not hang.is_set():
+            await asyncio.Event().wait()  # hang forever (first probe)
+        served_after_hang.append(1)
+        yield Annotated.from_data({"tok": 1}).to_dict()
+
+    a = await ep.serve_endpoint(handler)
+    t = [0.0]
+    health = HealthTracker(failure_threshold=1, cooldown_s=5.0, clock=lambda: t[0])
+    client = await ep.client(health=health)
+    await client.wait_for_instances(1, timeout=2)
+    router = fast_router(client, retries=0)
+
+    health.record_failure(a.instance_id)
+    breaker = health.breaker(a.instance_id)
+    assert breaker.state is BreakerState.OPEN
+    t[0] = 6.0  # cooldown over: the next dispatch claims the probe
+
+    task = asyncio.ensure_future(router.generate({}))
+    await asyncio.sleep(0.05)  # parked inside open_stream's first-frame pull
+    assert breaker.state is BreakerState.HALF_OPEN
+    task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    # THE regression: the cancelled dispatch must free the probe slot.
+    assert breaker.state is BreakerState.HALF_OPEN
+    assert breaker.would_allow()
+
+    # Breaker exits HALF_OPEN: the next probe succeeds and closes it.
+    hang.set()
+    out = await collect(await router.generate({}))
+    assert [o["tok"] for o in out] == [1]
+    assert breaker.state is BreakerState.CLOSED
+    assert served_after_hang == [1]
+    await drt.close()
+
+
 async def test_queue_size_outage_means_prefill_locally():
     """Satellite: a broken queue.size() must not crash the request — the
     decision degrades to local prefill (best-effort contract)."""
