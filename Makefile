@@ -9,6 +9,10 @@ CHAOS_SEED_SETS := 7,21,1337 11,23,4242 1,2,3
 # Recovery seed set: the mid-stream-failover (resumable streams) suite
 # sweeps crash-at-token faults under these seeds pre-merge.
 RECOVERY_SEED_SETS := 7,21,1337 5,8,13
+# Overload seed sets: seeded overload_burst scenarios (mixed-priority
+# bursts against a tiny KV pool) driving edge shedding + KV-pressure
+# preemption in tests/test_overload.py.
+OVERLOAD_SEED_SETS := 7,21,1337 3,9,27
 
 .PHONY: test pre-merge nightly chaos lint
 
@@ -33,6 +37,10 @@ chaos:
 	for seeds in $(RECOVERY_SEED_SETS); do \
 		echo "=== recovery suite, CHAOS_SEEDS=$$seeds ==="; \
 		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_resumable.py -q -m chaos; \
+	done; \
+	for seeds in $(OVERLOAD_SEED_SETS); do \
+		echo "=== overload suite, CHAOS_SEEDS=$$seeds ==="; \
+		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_overload.py -q -m chaos; \
 	done
 
 lint:

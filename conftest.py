@@ -77,6 +77,16 @@ _NIGHTLY_TESTS = {
     "test_engine_lease_confirm_releases_without_reclaim",
     "test_prefill_worker_leaves_lease_to_reaper_on_delivery_failure",
     "test_sse_stream_gapless_and_duplicate_free_across_failover",
+    # Real-TPUEngine overload/preemption proofs (compile-heavy; the
+    # admission/scheduler/routing units in the same file stay pre_merge).
+    "test_waiting_queue_reaps_cancelled_anywhere",
+    "test_preempt_resume_greedy_token_identity",
+    "test_preempt_resume_seeded_token_identity",
+    "test_preempt_resume_penalized_restores_counts",
+    "test_engine_drops_expired_at_admission",
+    "test_capacity_exceeding_requests_finish_instead_of_hanging",
+    "test_preemption_disabled_by_negative_grace",
+    "test_overload_burst_no_hangs_sheds_tagged_streams_identical",
 }
 
 

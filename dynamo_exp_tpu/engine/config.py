@@ -76,6 +76,17 @@ class EngineConfig:
     host_cache_pages: int = 0
     # Emit KV stored/removed events for the router index.
     enable_kv_events: bool = True
+    # KV-pressure preemption (docs/fault_tolerance.md "Overload
+    # protection"): when the page pool is dry and an ACTIVE row has been
+    # hard-stalled (cannot feed its next token) longer than this grace,
+    # the engine preempts the lowest-priority / youngest ACTIVE sequence
+    # — releasing its pages and requeueing it as a deterministic
+    # continuation — instead of parking stalled slots forever. Negative
+    # disables preemption entirely.
+    preempt_stall_grace_s: float = 0.5
+    # Per-request preemption bound: a sequence preempted this many times
+    # is exempt from further victimization (no re-prefill live-lock).
+    max_preemptions_per_seq: int = 2
     # Disaggregation KV-handoff lease TTL: extracted prompt pages stay
     # pinned in HBM this long awaiting the decode worker's delivery ack;
     # the engine-loop reaper reclaims orphans (decode instance died

@@ -244,6 +244,7 @@ class TPUEngine(AsyncEngine):
         self._thread: threading.Thread | None = None
         self.steps = 0  # decode step counter (metrics)
         self._last_gauge_pub = 0.0  # telemetry gauge throttle
+        self._last_reap = 0.0  # waiting-deque reap throttle
         # Chained decode: the dispatched-but-unconsumed window (if any).
         self._inflight: _PendingDecode | None = None
         # Occupancy/movement counters (mirrored to /metrics counters and
@@ -251,6 +252,7 @@ class TPUEngine(AsyncEngine):
         self.wasted_steps = 0  # window steps computed past a row's stop
         self.kv_page_moves = 0  # pages moved by batched gather/scatter
         self.kv_move_dispatches = 0  # batched-move dispatches issued
+        self.preempted = 0  # sequences preempted under KV pressure
         # KV handoff leases: confirmations arrive from asyncio threads
         # (the prefill worker's delivery ack) but the page manager is
         # single-writer — queue them for the loop thread, which also
@@ -574,6 +576,8 @@ class TPUEngine(AsyncEngine):
             trace=current_trace(),
             submitted_at=time.time(),
             sample_seed=self._effective_seed(binput),
+            priority=binput.priority,
+            deadline_unix=ctx.deadline or 0.0,
         )
         self._submit_q.put(seq)
         self._wake.set()
@@ -661,6 +665,8 @@ class TPUEngine(AsyncEngine):
             trace=current_trace(),
             submitted_at=time.time(),
             sample_seed=self._effective_seed(binput),
+            priority=binput.priority,
+            deadline_unix=ctx.deadline or 0.0,
         )
         self._submit_q.put(seq)
         self._wake.set()
@@ -726,8 +732,22 @@ class TPUEngine(AsyncEngine):
                     continue
                 self._drain_submissions()
                 self._poll_cancellations()
-                while (admitted := self.sched.admit_next()) is not None:
-                    self._on_admitted(admitted)
+                # Reap dead work anywhere in the waiting deque before it
+                # can waste a prefill or hold an admission slot. The full
+                # O(queue-depth) scan is throttled: the loop can spin at
+                # kHz when the pool is dry, and admit_next's head check
+                # still prevents a wasted prefill between scans.
+                now_m = time.monotonic()
+                if now_m - self._last_reap >= 0.02:
+                    self._last_reap = now_m
+                    self.sched.reap_waiting()
+                # KV pressure: no window is in flight here (the chain
+                # broke above or never existed), so releasing a victim's
+                # pages cannot race a device write.
+                self._maybe_preempt()
+                if not self._kv_pressure():
+                    while (admitted := self.sched.admit_next()) is not None:
+                        self._on_admitted(admitted)
                 self._maybe_publish_gauges()
                 progressed = False
                 prefilling = [
@@ -827,10 +847,81 @@ class TPUEngine(AsyncEngine):
             except queue.Empty:
                 return
 
+    # ------------------------------------------------------- overload control
+    def _kv_pressure(self) -> bool:
+        """True while any bound row is hard-stalled (cannot feed its
+        next token because the pool is dry). Admission pauses under this
+        condition: a newcomer's allocation would take the very pages the
+        stalled rows are waiting for — including pages a preemption just
+        parked for them."""
+        return any(
+            s is not None and s.stalled_since for s in self.sched.slots
+        )
+
+    def _maybe_preempt(self) -> None:
+        """KV-pressure preemption (docs/fault_tolerance.md "Overload
+        protection"): once a row has been hard-stalled past the grace
+        period, evict the lowest-priority / youngest ACTIVE sequence —
+        its pages park (reusable, offload-tier write-back on eviction)
+        and it requeues as a deterministic continuation of itself, so
+        its stream resumes token-identically once pressure clears.
+        Bounded per request by ``max_preemptions_per_seq``; each event
+        lands in the trace timeline as a ``preemption`` span."""
+        grace = self.cfg.preempt_stall_grace_s
+        if grace < 0:
+            return
+        now = time.time()
+        if not any(
+            s is not None
+            and s.stalled_since
+            and now - s.stalled_since >= grace
+            for s in self.sched.slots
+        ):
+            return
+        if self.sched.active_count <= 1 and not self.sched.waiting:
+            return  # nothing to yield the freed pages to
+        victim = self.sched.preemption_victim(self.cfg.max_preemptions_per_seq)
+        if victim is None:
+            return
+        t0 = victim.stalled_since or now
+        freed = len(victim.page_ids)
+        generated = victim.generated
+        self.sched.preempt(victim)
+        self.preempted += 1
+        tel = get_telemetry()
+        tel.preemptions.labels("kv_pressure").inc()
+        tel.emit_stage(
+            "preemption",
+            t0,
+            now,
+            victim.trace,
+            generated_tokens=generated,
+            freed_pages=freed,
+            priority=victim.priority,
+            preemption=victim.preemptions,
+        )
+        log.warning(
+            "KV pressure: preempted request %s (priority=%d, %d tokens "
+            "generated, %d pages freed, preemption %d/%d); resuming as a "
+            "deterministic continuation",
+            victim.request_id, victim.priority, generated, freed,
+            victim.preemptions, self.cfg.max_preemptions_per_seq,
+        )
+
     def _poll_cancellations(self) -> None:
+        now = time.time()
         for s in list(self.sched.slots):
-            if s is not None and s.is_cancelled():
+            if s is None:
+                continue
+            if s.is_cancelled():
                 self.sched.finish(s, FinishReason.CANCELLED)
+            elif s.deadline_unix and now >= s.deadline_unix:
+                # Bound rows honor deadlines too — without this, a row
+                # stalled at its preemption bound with an expired
+                # deadline would hold its slot and pages until the
+                # client disconnected.
+                get_telemetry().deadline_exceeded.labels("decode").inc()
+                self.sched.finish(s, FinishReason.ERROR)
 
     def _fail_all(self) -> None:
         for s in list(self.sched.slots):
@@ -1177,11 +1268,31 @@ class TPUEngine(AsyncEngine):
             self.sched.ensure_pages_until(seq, wpos + K - 1)
             cap = min(cfg.max_model_len, len(seq.page_ids) * ps) - 1
             if cap < wpos:
+                if wpos // ps >= self.kv.num_pages:
+                    # The row's own context now exceeds the ENTIRE pool:
+                    # no preemption or wait can ever feed its next token
+                    # on this engine. The pool is this deployment's hard
+                    # context capacity — close the stream with what it
+                    # has (mirrors the max_model_len LENGTH) instead of
+                    # stalling the slot forever.
+                    log.warning(
+                        "request %s reached the KV pool's context "
+                        "capacity (%d pages) at %d tokens; finishing "
+                        "with length",
+                        seq.request_id, self.kv.num_pages, wpos,
+                    )
+                    self.sched.finish(seq, FinishReason.LENGTH)
+                    continue
+                # Hard stall: the row cannot even feed its next token.
+                # Start (or keep) the preemption grace clock.
                 seq.stalled = True
+                if not seq.stalled_since:
+                    seq.stalled_since = time.time()
                 continue  # pool dry: this slot idles one window
             seq.stalled = len(seq.page_ids) * ps < min(
                 wpos + K, cfg.max_model_len
             )
+            seq.stalled_since = 0.0  # progressing (even if window-capped)
             part = sampler if self._needs_sampler(seq) else greedy
             part.append((seq, wpos, cap))
         out: list[_PendingDecode] = []
@@ -1303,6 +1414,7 @@ class TPUEngine(AsyncEngine):
         if not self._submit_q.empty() or self.sched.waiting:
             return False
         stepped_seqs = {id(seq) for seq, _, _ in p.stepped}
+        now = time.time()
         for s in self.sched.slots:
             if s is None:
                 continue
@@ -1310,6 +1422,8 @@ class TPUEngine(AsyncEngine):
                 return False
             if s.is_cancelled():
                 return False
+            if s.deadline_unix and now >= s.deadline_unix:
+                return False  # break the chain so the deadline is enforced
             if s.state is SeqState.ACTIVE and id(s) not in stepped_seqs:
                 # A row joined (finished prefill) or sat out (stalled)
                 # after the chain started; chaining over the old row set
@@ -1482,6 +1596,7 @@ class TPUEngine(AsyncEngine):
         m["decode_wasted_steps"] = self.wasted_steps
         m["kv_page_moves"] = self.kv_page_moves
         m["kv_move_dispatches"] = self.kv_move_dispatches
+        m["preemptions"] = self.preempted
         m["kv_leases_active"] = self.kv.active_leases
         m["kv_lease_reclaimed_pages"] = self.kv.lease_reclaimed_pages
         m["compiled_decode_variants"] = len(self._decode_fns)

@@ -78,6 +78,19 @@ class Sequence:
     hashed_pages: int = 0  # count of pages already registered
     # Set when the pool ran dry mid-decode; slot idles until a page frees.
     stalled: bool = False
+    # When a *hard* stall began (the row cannot even feed its next
+    # token): the KV-pressure preemption grace clock. 0.0 = not stalled.
+    stalled_since: float = 0.0
+    # Admission priority class (0=low, 1=normal, 2=high): the edge sheds
+    # low first; KV-pressure preemption victimizes low first.
+    priority: int = 1
+    # End-to-end deadline (unix seconds, 0 = none), captured from the
+    # request context at submission so the engine can reap expired work
+    # from the waiting queue before it wastes prefill.
+    deadline_unix: float = 0.0
+    # KV-pressure preemptions suffered so far (bounded per request by
+    # EngineConfig.max_preemptions_per_seq).
+    preemptions: int = 0
     # Stop discovered while a chained decode window was still in flight:
     # the finish (and its page release) is deferred until that window is
     # consumed, so the device can't write into reallocated pages. The
@@ -135,6 +148,37 @@ class Scheduler:
     def submit(self, seq: Sequence) -> None:
         self.waiting.append(seq)
 
+    def reap_waiting(self, now: float | None = None) -> int:
+        """Drop cancelled and deadline-expired sequences *anywhere* in
+        the waiting deque — not just at the head — so dead requests
+        neither inflate queue-depth gauges / admission bounds nor waste
+        a prefill when their turn comes. Returns the number reaped."""
+        if not self.waiting:
+            return 0
+        now = time.time() if now is None else now
+        kept: list[Sequence] = []
+        reaped = 0
+        for seq in self.waiting:
+            if seq.is_cancelled():
+                seq.state = SeqState.FINISHED
+                seq.emit([], FinishReason.CANCELLED)
+                reaped += 1
+            elif seq.deadline_unix and now >= seq.deadline_unix:
+                # Mirror of the prefill worker's pre-compute drop (PR 2):
+                # the client has already given up; admitting would burn a
+                # slot and a prefill on undeliverable work.
+                seq.state = SeqState.FINISHED
+                get_telemetry().deadline_exceeded.labels(
+                    "engine_admission"
+                ).inc()
+                seq.emit([], FinishReason.ERROR)
+                reaped += 1
+            else:
+                kept.append(seq)
+        if reaped:
+            self.waiting = deque(kept)
+        return reaped
+
     def has_work(self) -> bool:
         return self.active_count > 0 or bool(self.waiting)
 
@@ -154,12 +198,31 @@ class Scheduler:
                 seq.state = SeqState.FINISHED
                 seq.emit([], FinishReason.CANCELLED)
                 continue
+            head = self.waiting[0]
+            if head.deadline_unix and time.time() >= head.deadline_unix:
+                # The engine-loop reap is throttled; never let expired
+                # work slip through admission in between scans.
+                self.waiting.popleft()
+                head.state = SeqState.FINISHED
+                get_telemetry().deadline_exceeded.labels(
+                    "engine_admission"
+                ).inc()
+                head.emit([], FinishReason.ERROR)
+                continue
             slot = self.free_slot()
             if slot is None:
                 return None
             seq = self.waiting[0]
-            if len(seq.prompt) > self.cfg.max_model_len or (
-                self.cfg.bucket_for(
+            ps = self.kv.page_size
+            if (
+                len(seq.prompt) > self.cfg.max_model_len
+                # A prompt needing more pages than the pool *has* can
+                # never be allocated — reject instead of waiting forever.
+                # Reachable from small prompts: a preempted sequence's
+                # continuation prompt is its full generated context,
+                # which can outgrow a pool smaller than max_model_len.
+                or (len(seq.prompt) + ps - 1) // ps > self.kv.num_pages
+                or self.cfg.bucket_for(
                     min(len(seq.prompt), self.cfg.prefill_chunk)
                 )
                 is None
@@ -269,6 +332,86 @@ class Scheduler:
             seq.slot = -1
         self.kv.release_sequence(seq.page_ids)
         seq.emit([], reason)
+
+    # ------------------------------------------------------------ preemption
+    def preemption_victim(self, max_preemptions: int) -> Sequence | None:
+        """The sequence KV-pressure preemption evicts next: lowest
+        priority first, youngest (latest-submitted) on ties — the work
+        with the least sunk cost and the weakest claim. Sequences at
+        their preemption bound are exempt (they would otherwise
+        live-lock re-prefilling forever), as are extract-mode sequences
+        (disagg prefill workers: their one token is already sampled).
+        Returns None when nothing qualifies."""
+        candidates = [
+            s
+            for s in self.slots
+            if s is not None
+            and s.state is SeqState.ACTIVE
+            and s.pending_finish is None
+            and s.extract_cb is None
+            and s.preemptions < max_preemptions
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda s: (s.priority, -s.submitted_at))
+
+    def preempt(self, seq: Sequence) -> None:
+        """Unbind an ACTIVE sequence from its slot, release its pages,
+        and requeue it as a deterministic continuation of itself.
+
+        The released *registered* pages park in the reclaimable LRU
+        (write-back to the host offload tier on eviction), so a prompt
+        re-admission soon after usually prefix-hits most of its own
+        context. The continuation re-enters as a fresh request whose
+        prompt is the full generated context; counter-based sampling —
+        every draw keyed by (seed, absolute position) — makes the
+        resumed stream token-identical to the uninterrupted run, so the
+        client-facing SSE stream stays gapless (the continuation emits
+        only tokens past the splice). Requeues at the *back* of the
+        waiting deque: re-admitting immediately would revive the pages
+        just parked and starve the stalled rows the preemption was
+        meant to feed."""
+        k = seq.generated
+        if seq.slot >= 0:
+            self.slots[seq.slot] = None
+            self.active_count -= 1
+            seq.slot = -1
+        self.kv.release_sequence(seq.page_ids)
+        seq.page_ids = []
+        stop = seq.stop.model_copy(deep=True)
+        sc = stop.stop_conditions
+        orig_max = (
+            sc.max_tokens
+            if sc.max_tokens is not None
+            else self.cfg.default_max_tokens
+        )
+        sc.max_tokens = max(orig_max - k, 1)
+        if sc.min_tokens:
+            sc.min_tokens = max(sc.min_tokens - k, 0)
+        # Cumulative across preemptions: ``resume_offset`` marks how much
+        # of the new prompt is journaled *completion* tokens, so the
+        # sampler's penalty counts rebuild over all of them at re-prefill
+        # (engine._finish_first_token).
+        stop.resume_offset = (seq.stop.resume_offset or 0) + k
+        stop.token_ids = list(seq.tokens)
+        seq.stop = stop
+        seq.prompt = list(seq.tokens)
+        seq.tokens = []
+        seq.generated = 0
+        seq.prefill_sent = 0
+        seq.cached_len = 0
+        seq.stalled = False
+        seq.stalled_since = 0.0
+        seq.pending_finish = None
+        seq.pending_uploads = []
+        seq.prompt_hashes = []
+        seq.hashed_pages = 0
+        seq.parent_hash = None
+        seq.remote_kv = None
+        seq.remote_prefilled = False
+        seq.preemptions += 1
+        seq.state = SeqState.WAITING
+        self.waiting.append(seq)
 
     # -------------------------------------------------------------- stopping
     def check_stop(self, seq: Sequence, token: int) -> FinishReason | None:

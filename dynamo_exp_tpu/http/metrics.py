@@ -59,6 +59,13 @@ class ServiceMetrics:
     def track(self, model: str, endpoint: str, request_type: str) -> "RequestTracker":
         return RequestTracker(self, model, endpoint, request_type)
 
+    def count_shed(self, model: str, endpoint: str, status: int) -> None:
+        """One admission-shed request (429/503). The stream/unary split
+        never happened for a shed request, so request_type is 'shed';
+        the per-priority breakdown lives on the telemetry counter
+        ``dynamo_requests_shed_total``."""
+        self.requests_total.labels(model, endpoint, "shed", f"shed_{status}").inc()
+
 
 class RequestTracker:
     """Context manager recording one request's metrics."""

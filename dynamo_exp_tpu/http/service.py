@@ -30,6 +30,7 @@ from ..runtime.annotated import Annotated
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, DeadlineExceededError
 from ..runtime.push_router import NoInstancesError, RecoveryExhaustedError
 from ..telemetry import get_telemetry, span
+from .admission import AdmissionController, RequestShedError, parse_priority
 from .metrics import CONTENT_TYPE_LATEST, ServiceMetrics
 
 # Clients hint how soon to retry a 503 (no instances / breaker open):
@@ -80,6 +81,7 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         request_template=None,
+        admission: AdmissionController | None = None,
     ):
         self.manager = manager or ModelManager()
         self.metrics = metrics or ServiceMetrics()
@@ -88,6 +90,10 @@ class HttpService:
         # Server-side defaults for sparse request bodies (reference:
         # request_template.rs applied in dynamo-run's HTTP input).
         self.request_template = request_template
+        # Overload protection: bounded in-flight work with priority-aware
+        # shedding (docs/fault_tolerance.md). None = accept unboundedly
+        # (embedded/test deployments that bound load elsewhere).
+        self.admission = admission
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat)
         self.app.router.add_post("/v1/completions", self._completions)
@@ -173,6 +179,13 @@ class HttpService:
             # Popped before parsing so strict models don't reject it.
             timeout_s = _request_timeout_s(payload, request)
             req = parse(payload)
+            priority = _request_priority(req, request)
+            # Canonicalize into the forwarded payload: the engine's
+            # preemption victim selection must see the same class the
+            # edge admitted under — a header-only spelling would
+            # otherwise never reach the preprocessor.
+            if isinstance(payload, dict):
+                payload["priority"] = priority
         except Exception as e:
             return _error_response(400, f"invalid request: {e}")
         engine = lookup(req.model)
@@ -180,6 +193,54 @@ class HttpService:
             return _error_response(
                 404, f"model {req.model!r} not found", err_type="model_not_found"
             )
+        if self.admission is not None:
+            # Overload protection: bounded in-flight work. Above the shed
+            # watermark lower-priority classes get 429; at the hard cap
+            # everything gets 503. Both carry Retry-After — the request
+            # was fine, the service is busy.
+            try:
+                self.admission.acquire(priority)
+            except RequestShedError as e:
+                self.metrics.count_shed(req.model, endpoint, e.status)
+                return _error_response(
+                    e.status,
+                    str(e),
+                    err_type=(
+                        "service_overloaded" if e.status == 503 else "request_shed"
+                    ),
+                    headers={"Retry-After": str(max(int(e.retry_after_s), 1))},
+                )
+        try:
+            return await self._serve_admitted(
+                request,
+                req,
+                engine,
+                timeout_s,
+                payload=payload,
+                chunk_type=chunk_type,
+                aggregate=aggregate,
+                endpoint=endpoint,
+                expand_batch=expand_batch,
+            )
+        finally:
+            # Released only when the response is complete (the SSE stream
+            # has drained) — in-flight covers the full request lifetime.
+            if self.admission is not None:
+                self.admission.release()
+
+    async def _serve_admitted(
+        self,
+        request: web.Request,
+        req,
+        engine: AsyncEngine,
+        timeout_s: float | None,
+        *,
+        payload,
+        chunk_type,
+        aggregate,
+        endpoint: str,
+        expand_batch,
+    ) -> web.StreamResponse:
         # OpenAI allows a list of prompts in one completion request; fan the
         # batch out as independent sub-requests with re-indexed choices.
         sub_payloads = expand_batch(payload) if expand_batch else [payload]
@@ -369,6 +430,19 @@ def _expand_completion_batch(payload: dict) -> list[dict]:
     if isinstance(prompt, list) and prompt and not isinstance(prompt[0], int):
         return [{**payload, "prompt": p} for p in prompt]
     return [payload]
+
+
+def _request_priority(req: Any, request: web.Request) -> int:
+    """Admission priority class: the body/nvext ``priority`` field wins
+    over the ``X-Request-Priority`` header; absent means ``normal``.
+    Invalid spellings raise (the caller maps to 400)."""
+    raw = None
+    getter = getattr(req, "request_priority", None)
+    if getter is not None:
+        raw = getter()
+    if raw is None:
+        raw = request.headers.get("X-Request-Priority")
+    return parse_priority(raw)
 
 
 def _request_timeout_s(payload: Any, request: web.Request) -> float | None:

@@ -2,11 +2,17 @@
 
 Capability parity with ``/root/reference/lib/llm/src/kv_router/scheduler.rs``
 (:88-310): pluggable ``WorkerSelector`` over live endpoint metrics +
-overlap scores; the default cost is the reference's
+overlap scores; the default cost extends the reference's
 
     logit = 2 * overlap_ratio - gpu_cache_usage - normalized_active
 
-with random tie-breaking (scheduler.rs:239-310).
+with random tie-breaking (scheduler.rs:239-310) by a **queue-depth
+penalty**: ``- queue_weight * waiting / total_slots``, fed from the
+``num_requests_waiting`` gauge the metrics aggregator already scrapes.
+Without it a saturated instance with a deep waiting queue but a good
+prefix overlap keeps attracting work (NetKV's observation, PAPERS.md);
+with it, load sheds toward idle instances once the backlog outweighs
+the overlap advantage.
 """
 
 from __future__ import annotations
@@ -46,8 +52,14 @@ class NoWorkersError(RuntimeError):
 
 
 class DefaultWorkerSelector:
-    def __init__(self, rng: random.Random | None = None):
+    def __init__(
+        self, rng: random.Random | None = None, queue_weight: float = 1.0
+    ):
         self.rng = rng or random.Random()
+        # Weight of the queue-depth penalty (waiting / total_slots). 0
+        # restores the pure reference cost; 1.0 makes one slot-envelope
+        # of backlog as repulsive as a fully busy decode batch.
+        self.queue_weight = queue_weight
 
     def select_worker(
         self,
@@ -70,7 +82,17 @@ class DefaultWorkerSelector:
                 if m.request_total_slots
                 else 0.0
             )
-            logit = 2.0 * overlap_ratio - m.gpu_cache_usage_perc - normalized_active
+            normalized_waiting = (
+                m.num_requests_waiting / m.request_total_slots
+                if m.request_total_slots
+                else float(m.num_requests_waiting > 0)
+            )
+            logit = (
+                2.0 * overlap_ratio
+                - m.gpu_cache_usage_perc
+                - normalized_active
+                - self.queue_weight * normalized_waiting
+            )
             if logit > best_logit + 1e-12:
                 best_logit = logit
                 best_ids = [wid]

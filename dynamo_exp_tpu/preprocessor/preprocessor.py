@@ -12,7 +12,12 @@ from __future__ import annotations
 from typing import Any, AsyncIterator
 
 from ..model_card import ModelDeploymentCard
-from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
+from ..protocols.common import (
+    BackendInput,
+    FinishReason,
+    LLMEngineOutput,
+    parse_priority,
+)
 from ..protocols.delta import ChatDeltaGenerator, CompletionDeltaGenerator
 from ..protocols.openai import ChatCompletionRequest, CompletionRequest
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
@@ -89,11 +94,16 @@ class OpenAIPreprocessor(Operator):
             )
         # Default generation budget: fill the remaining context.
         stop.apply_defaults(self.mdc.context_length - len(token_ids))
+        try:
+            priority = parse_priority(request.request_priority())
+        except ValueError as e:
+            raise InvalidRequestError(str(e)) from None
         return BackendInput(
             token_ids=token_ids,
             stop_conditions=stop,
             sampling_options=sampling,
             annotations=request.annotations(),
+            priority=priority,
         )
 
     # --- pipeline operator --------------------------------------------

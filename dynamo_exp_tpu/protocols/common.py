@@ -14,6 +14,50 @@ from typing import Any
 from pydantic import BaseModel, Field
 
 
+PRIORITY_LOW = 0
+PRIORITY_NORMAL = 1
+PRIORITY_HIGH = 2
+
+_PRIORITY_NAMES = {
+    "low": PRIORITY_LOW,
+    "normal": PRIORITY_NORMAL,
+    "high": PRIORITY_HIGH,
+}
+_NAME_BY_PRIORITY = {v: k for k, v in _PRIORITY_NAMES.items()}
+
+
+def parse_priority(raw) -> int:
+    """Normalize a request's priority class to 0/1/2 (low/normal/high).
+
+    Accepts the class names (case-insensitive) or their integers;
+    ``None`` means ``normal``. Anything else raises ``ValueError`` (the
+    HTTP layer maps it to 400) — a client that *tried* to prioritize
+    deserves to know the spelling was wrong, not a silent ``normal``."""
+    if raw is None:
+        return PRIORITY_NORMAL
+    if isinstance(raw, bool):  # bool is an int subclass; reject explicitly
+        raise ValueError(f"invalid priority: {raw!r}")
+    if isinstance(raw, int):
+        if raw in _NAME_BY_PRIORITY:
+            return raw
+        raise ValueError(
+            f"invalid priority: {raw!r} (expected 0..2 or low/normal/high)"
+        )
+    if isinstance(raw, str):
+        name = raw.strip().lower()
+        if name in _PRIORITY_NAMES:
+            return _PRIORITY_NAMES[name]
+        if name.lstrip("-").isdigit():
+            return parse_priority(int(name))
+    raise ValueError(
+        f"invalid priority: {raw!r} (expected 0..2 or low/normal/high)"
+    )
+
+
+def priority_name(priority: int) -> str:
+    return _NAME_BY_PRIORITY.get(priority, str(priority))
+
+
 class FinishReason(str, enum.Enum):
     EOS = "eos"
     STOP = "stop"
@@ -80,6 +124,10 @@ class BackendInput(BaseModel):
     # over the whole sequence); the field marks the re-prefill hop for
     # telemetry and accounting — the journaling router owns usage fixup.
     resume_offset: int | None = None
+    # Admission-control priority class (0=low, 1=normal, 2=high). The
+    # edge sheds low first under load; the engine preempts the
+    # lowest-priority ACTIVE sequence first under KV pressure.
+    priority: int = 1
 
     def to_dict(self) -> dict:
         return self.model_dump(exclude_none=True)

@@ -23,6 +23,11 @@ class Extensions(BaseModel):
     ignore_eos: bool | None = None
     annotations: list[str] = Field(default_factory=list)
     greedy_sampling: bool | None = None
+    # Admission-control priority class ("low" | "normal" | "high" or
+    # 0/1/2); also accepted as a top-level ``priority`` field or the
+    # ``X-Request-Priority`` header. Under overload, low-priority work
+    # is shed first (docs/fault_tolerance.md "Overload protection").
+    priority: str | int | None = None
 
 
 class ChatMessage(BaseModel):
@@ -73,12 +78,19 @@ class ChatCompletionRequest(BaseModel):
     tool_choice: Any | None = None
     min_tokens: int | None = None
     ignore_eos: bool | None = None
+    priority: str | int | None = None
     nvext: Extensions | None = None
 
     def stop_list(self) -> list[str]:
         if self.stop is None:
             return []
         return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def request_priority(self) -> str | int | None:
+        """Raw priority class: body field wins over the nvext one."""
+        if self.priority is not None:
+            return self.priority
+        return self.nvext.priority if self.nvext else None
 
     def extract_stop_conditions(self) -> StopConditions:
         return StopConditions(
@@ -130,12 +142,19 @@ class CompletionRequest(BaseModel):
     user: str | None = None
     min_tokens: int | None = None
     ignore_eos: bool | None = None
+    priority: str | int | None = None
     nvext: Extensions | None = None
 
     def stop_list(self) -> list[str]:
         if self.stop is None:
             return []
         return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def request_priority(self) -> str | int | None:
+        """Raw priority class: body field wins over the nvext one."""
+        if self.priority is not None:
+            return self.priority
+        return self.nvext.priority if self.nvext else None
 
     def extract_stop_conditions(self) -> StopConditions:
         return StopConditions(

@@ -69,6 +69,15 @@ def parse_args(argv: list[str]):
     p.add_argument("--request-template", default="",
                    help="JSON file of request defaults (model/temperature/"
                         "max_completion_tokens), reference request_template.rs")
+    # Overload protection at the HTTP edge (docs/fault_tolerance.md
+    # "Overload protection"): bounded in-flight work, priority-aware
+    # shedding (429 + Retry-After), hard-cap 503.
+    p.add_argument("--max-inflight", type=int, default=0,
+                   help="hard cap on concurrently admitted HTTP requests "
+                        "(503 above it); 0 disables admission control")
+    p.add_argument("--shed-watermark", type=int, default=0,
+                   help="in-flight level where low-priority requests start "
+                        "shedding with 429 (default: 3/4 of --max-inflight)")
     p.add_argument("--profiler-port", type=int, default=0,
                    help="expose the jax.profiler gRPC server on this port "
                         "(attach with tensorboard/xprof); 0 = off")
@@ -278,8 +287,19 @@ async def run_http(opts, drt, core, full, mdc):
         from .protocols.request_template import RequestTemplate
 
         template = RequestTemplate.load(opts.request_template)
+    admission = None
+    if opts.max_inflight > 0:
+        from .http import AdmissionController
+
+        admission = AdmissionController(
+            max_inflight=opts.max_inflight,
+            shed_watermark=opts.shed_watermark or None,
+        )
     svc = HttpService(
-        host=opts.http_host, port=opts.http_port, request_template=template
+        host=opts.http_host,
+        port=opts.http_port,
+        request_template=template,
+        admission=admission,
     )
     watcher = None
     kv_router = None

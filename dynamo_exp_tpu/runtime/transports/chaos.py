@@ -198,6 +198,55 @@ class ChaosSchedule:
         await asyncio.sleep(fault.delay_s + jitter)
 
 
+@dataclass
+class BurstRequest:
+    """One request of a seeded overload burst (see :func:`overload_burst`)."""
+
+    index: int
+    priority: str  # "low" | "normal" | "high"
+    prompt: list[int]
+    max_tokens: int
+    delay_s: float  # submission offset within the burst
+    seed: int  # sampling seed (pinned, so replays are token-identical)
+
+
+def overload_burst(
+    seed: int,
+    n: int = 12,
+    priorities: tuple[str, ...] = ("low", "normal", "high"),
+    isl_range: tuple[int, int] = (4, 12),
+    osl_range: tuple[int, int] = (6, 16),
+    vocab_range: tuple[int, int] = (3, 200),
+    spread_s: float = 0.0,
+) -> list[BurstRequest]:
+    """A deterministic N-request burst with mixed priorities — the
+    overload-protection scenario (``tests/test_overload.py``, ``make
+    chaos``): fired against a deliberately tiny KV pool, it must drive
+    edge shedding (429/503) and engine KV-pressure preemption without
+    ever hanging a request.
+
+    Everything — prompts, lengths, priorities, per-request sampling
+    seeds, submission jitter — derives from ``random.Random(seed)``, so
+    a given seed always produces the same burst and cross-run
+    determinism assertions hold bit-for-bit.
+    """
+    rng = random.Random(seed)
+    burst = []
+    for i in range(n):
+        isl = rng.randint(*isl_range)
+        burst.append(
+            BurstRequest(
+                index=i,
+                priority=priorities[rng.randrange(len(priorities))],
+                prompt=[rng.randint(*vocab_range) for _ in range(isl)],
+                max_tokens=rng.randint(*osl_range),
+                delay_s=rng.random() * spread_s,
+                seed=rng.getrandbits(31),
+            )
+        )
+    return burst
+
+
 class ChaosRequestPlane(RequestPlane):
     """RequestPlane decorator injecting scheduled faults client-side."""
 

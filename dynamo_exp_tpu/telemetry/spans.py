@@ -54,6 +54,9 @@ _ENGINE_GAUGES = (
     ("num_requests_running", "Sequences actively decoding"),
     ("num_requests_waiting", "Sequences waiting for admission"),
     ("decode_batch_utilization", "ACTIVE decode slots / total slots"),
+    ("request_stalled_slots",
+     "ACTIVE slots page-limited by the KV pool (idle, or window-capped "
+     "but still progressing)"),
 )
 
 
@@ -221,6 +224,28 @@ class Telemetry:
         self.kv_lease_reclaims = Counter(
             "dynamo_kv_lease_reclaims_total",
             "KV pages reclaimed from expired disagg handoff leases",
+            registry=self.registry,
+        )
+        # Overload protection (docs/fault_tolerance.md "Overload
+        # protection"): edge admission sheds, the edge's live in-flight
+        # count, and engine-side KV-pressure preemptions.
+        self.requests_shed = Counter(
+            "dynamo_requests_shed_total",
+            "Requests refused by edge admission control, by priority "
+            "class and HTTP status",
+            ["priority", "code"],  # low|normal|high x 429|503
+            registry=self.registry,
+        )
+        self.admission_inflight = Gauge(
+            "dynamo_admission_inflight",
+            "Requests currently admitted (in flight) at the HTTP edge",
+            registry=self.registry,
+        )
+        self.preemptions = Counter(
+            "dynamo_preemptions_total",
+            "Engine sequences preempted and requeued as deterministic "
+            "continuations, by cause",
+            ["reason"],  # kv_pressure
             registry=self.registry,
         )
 

@@ -157,6 +157,46 @@ async def test_sequence_longer_than_capacity_rejected(engine):
     assert final["finish_reason"] == "error"
 
 
+async def test_waiting_queue_reaps_cancelled_anywhere(engine):
+    """Satellite regression: a request cancelled while queued BEHIND
+    other waiting work is reaped from the middle of the deque (emitting
+    its CANCELLED finish) instead of inflating queue gauges until it
+    reaches the head."""
+    from dynamo_exp_tpu.runtime.engine import AsyncEngineContext
+
+    async def one(ctx=None, max_tokens=6):
+        prompt = list(np.random.RandomState(max_tokens).randint(3, 200, size=8))
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = max_tokens
+        b.stop_conditions.ignore_eos = True
+        stream = await engine.generate(b.to_dict(), ctx)
+        tokens, final = [], None
+        async for item in stream:
+            tokens.extend(item.get("token_ids", []))
+            if item.get("finish_reason"):
+                final = item
+        return tokens, final
+
+    # Fill all 4 slots with long-running work, then queue two more; the
+    # one cancelled while waiting must finish CANCELLED without tokens.
+    busy = [asyncio.create_task(one(max_tokens=48 + i)) for i in range(4)]
+    victim_ctx = AsyncEngineContext()
+    queued_victim = asyncio.create_task(one(victim_ctx, max_tokens=8))
+    queued_tail = asyncio.create_task(one(max_tokens=9))
+    while engine.metrics()["num_requests_waiting"] < 2:
+        await asyncio.sleep(0.01)
+    victim_ctx.stop_generating()  # cancel while queued mid-deque
+    tokens, final = await queued_victim
+    assert tokens == []
+    assert final["finish_reason"] == "cancelled"
+    # Everything else completes normally.
+    for t in busy:
+        _, f = await t
+        assert f["finish_reason"] == "length"
+    _, f = await queued_tail
+    assert f["finish_reason"] == "length"
+
+
 def test_kv_events_emitted():
     events = []
     cfg = EngineConfig(

@@ -1,0 +1,712 @@
+"""Overload protection: admission control, priority-aware load
+shedding, and KV-pressure preemption with deterministic resume
+(docs/fault_tolerance.md "Overload protection").
+
+Four layers under test:
+
+- **edge** (HTTP + AdmissionController): bounded in-flight work; above
+  the shed watermark lower-priority classes get 429 + Retry-After in
+  priority order, at the hard cap everything gets 503 + Retry-After —
+  the queue is never unbounded.
+- **scheduler**: cancelled and deadline-expired sequences are reaped
+  *anywhere* in the waiting deque (not just the head), and expired work
+  is dropped at engine admission before it wastes a prefill.
+- **engine** (real TPUEngine on the CPU mesh): when the KV pool runs
+  dry and a row hard-stalls past the grace period, the lowest-priority
+  / youngest ACTIVE sequence is preempted — pages released, requeued as
+  a deterministic continuation — and its resumed stream is
+  token-identical to an uninterrupted run (greedy AND seeded sampling),
+  bounded per request.
+- **router**: the KV-overlap selector's queue-depth penalty sheds work
+  away from instances with deep waiting queues.
+
+The ``overload_burst`` acceptance scenario (seeded, mixed priorities,
+8-page pool) runs under ``make chaos`` seed sets: no request hangs —
+every admitted stream finishes token-identically (preempted or not) and
+every shed request gets a 429/503 with Retry-After.
+"""
+
+import asyncio
+import os
+import random
+import time
+
+import pytest
+
+from dynamo_exp_tpu.http.admission import (
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    AdmissionController,
+    RequestShedError,
+    ServiceOverloadedError,
+    parse_priority,
+)
+from dynamo_exp_tpu.protocols.common import BackendInput, SamplingOptions
+from dynamo_exp_tpu.runtime.engine import AsyncEngineContext, ResponseStream
+from dynamo_exp_tpu.runtime.transports.chaos import overload_burst
+from dynamo_exp_tpu.telemetry import get_telemetry
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = tuple(
+    int(s) for s in os.environ.get("CHAOS_SEEDS", "7,21,1337").split(",")
+)
+
+PS = 8
+
+
+# ----------------------------------------------------- admission controller
+def test_priority_parsing():
+    assert parse_priority(None) == PRIORITY_NORMAL
+    assert parse_priority("low") == PRIORITY_LOW
+    assert parse_priority("HIGH") == PRIORITY_HIGH
+    assert parse_priority(" Normal ") == PRIORITY_NORMAL
+    assert parse_priority(0) == PRIORITY_LOW
+    assert parse_priority("2") == PRIORITY_HIGH
+    for bad in ("urgent", 3, -1, True, 1.5):
+        with pytest.raises(ValueError):
+            parse_priority(bad)
+
+
+def test_admission_graduated_thresholds_and_hard_cap():
+    """low sheds at the watermark, normal at the midpoint of the shed
+    band, high rides to the hard cap; at the cap everything is 503."""
+    adm = AdmissionController(max_inflight=8, shed_watermark=4)
+    assert [adm.threshold(p) for p in (0, 1, 2)] == [4, 6, 8]
+
+    for _ in range(4):
+        adm.acquire(PRIORITY_LOW)
+    with pytest.raises(RequestShedError) as e:
+        adm.acquire(PRIORITY_LOW)
+    assert e.value.status == 429 and not isinstance(
+        e.value, ServiceOverloadedError
+    )
+    adm.acquire(PRIORITY_NORMAL)
+    adm.acquire(PRIORITY_NORMAL)  # 6 in flight = normal's threshold
+    with pytest.raises(RequestShedError):
+        adm.acquire(PRIORITY_NORMAL)
+    adm.acquire(PRIORITY_HIGH)
+    adm.acquire(PRIORITY_HIGH)  # 8 in flight = the cap
+    with pytest.raises(ServiceOverloadedError) as e:
+        adm.acquire(PRIORITY_HIGH)
+    assert e.value.status == 503
+    assert adm.inflight == 8 and adm.shed_total == 3
+    for _ in range(8):
+        adm.release()
+    assert adm.inflight == 0
+    adm.acquire(PRIORITY_LOW)  # pressure gone: low admits again
+    adm.release()
+
+
+def test_admission_context_manager_releases_on_error():
+    adm = AdmissionController(max_inflight=2)
+    with pytest.raises(RuntimeError):
+        with adm.admit(PRIORITY_NORMAL):
+            assert adm.inflight == 1
+            raise RuntimeError("handler blew up")
+    assert adm.inflight == 0
+
+
+# ------------------------------------------------------------- HTTP edge
+class HoldEngine:
+    """OpenAI-level engine whose streams block until released — lets a
+    test pin the in-flight count at an exact level."""
+
+    def __init__(self):
+        self.release = asyncio.Event()
+        self.requests: list = []  # payloads as forwarded by the edge
+
+    async def generate(self, request, context=None):
+        self.requests.append(request)
+        ctx = context or AsyncEngineContext()
+
+        async def _gen():
+            await self.release.wait()
+            yield {
+                "id": "c",
+                "object": "text_completion",
+                "created": 1,
+                "model": request.get("model", "m"),
+                "choices": [
+                    {"index": 0, "text": "ok", "finish_reason": "stop"}
+                ],
+            }
+
+        return ResponseStream(_gen(), ctx)
+
+
+async def _held_service(adm):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from dynamo_exp_tpu.http import HttpService
+
+    engine = HoldEngine()
+    svc = HttpService(admission=adm)
+    svc.manager.add_completion_model("m", engine)
+    http = TestClient(TestServer(svc.app))
+    await http.start_server()
+    return http, engine
+
+
+def _completion_body(priority=None, **extra):
+    body = {"model": "m", "prompt": "x", "stream": True, **extra}
+    if priority is not None:
+        body["priority"] = priority
+    return body
+
+
+async def test_http_sheds_by_priority_then_hard_caps():
+    """Acceptance (edge): over the watermark low-priority work gets 429
+    + Retry-After while normal/high still admit; at the hard cap even
+    high gets 503 + Retry-After; after load drains everything admits."""
+    adm = AdmissionController(max_inflight=4, shed_watermark=2)
+    http, engine = await _held_service(adm)
+    held = [
+        asyncio.create_task(
+            http.post("/v1/completions", json=_completion_body())
+        )
+        for _ in range(2)
+    ]
+    while adm.inflight < 2:  # the two normals are admitted and held
+        await asyncio.sleep(0.01)
+
+    r = await http.post("/v1/completions", json=_completion_body("low"))
+    assert r.status == 429
+    assert r.headers["Retry-After"] == "1"
+    assert (await r.json())["error"]["type"] == "request_shed"
+
+    # Normal still admits (threshold 3) — hold it open too.
+    held.append(
+        asyncio.create_task(
+            http.post("/v1/completions", json=_completion_body())
+        )
+    )
+    while adm.inflight < 3:
+        await asyncio.sleep(0.01)
+    r = await http.post("/v1/completions", json=_completion_body())
+    assert r.status == 429  # normal's threshold reached
+
+    # High rides to the cap.
+    held.append(
+        asyncio.create_task(
+            http.post("/v1/completions", json=_completion_body("high"))
+        )
+    )
+    while adm.inflight < 4:
+        await asyncio.sleep(0.01)
+    r = await http.post("/v1/completions", json=_completion_body("high"))
+    assert r.status == 503
+    assert r.headers["Retry-After"] == "1"
+    assert (await r.json())["error"]["type"] == "service_overloaded"
+
+    engine.release.set()
+    for t in held:
+        r = await t
+        assert r.status == 200
+        await r.read()  # drain the SSE body so the handler can return
+    for _ in range(200):  # the server-side finally runs a tick later
+        if adm.inflight == 0:
+            break
+        await asyncio.sleep(0.01)
+    assert adm.inflight == 0  # released only after the streams drained
+    r = await http.post("/v1/completions", json=_completion_body("low"))
+    assert r.status == 200
+    await http.close()
+
+
+async def test_http_priority_header_and_invalid_priority_400():
+    adm = AdmissionController(max_inflight=4, shed_watermark=1)
+    http, engine = await _held_service(adm)
+    held = asyncio.create_task(
+        http.post("/v1/completions", json=_completion_body())
+    )
+    while adm.inflight < 1:
+        await asyncio.sleep(0.01)
+    # Header-only priority is honored (low sheds at the watermark)...
+    r = await http.post(
+        "/v1/completions",
+        json=_completion_body(),
+        headers={"X-Request-Priority": "low"},
+    )
+    assert r.status == 429
+    # ...and the body/nvext field wins over the header: high admits
+    # (SSE headers arrive with a 200) where low would have been shed.
+    r = await http.post(
+        "/v1/completions",
+        json={**_completion_body(), "nvext": {"priority": "high"}},
+        headers={"X-Request-Priority": "low"},
+    )
+    assert r.status == 200
+    # The body's class (not the header's) is what got canonicalized
+    # into the forwarded payload — the engine's preemption victim
+    # selection must see the class the edge admitted under.
+    assert engine.requests[-1]["priority"] == PRIORITY_HIGH
+    engine.release.set()
+    await r.read()
+    assert (await held).status == 200
+    # Header-only spelling reaches the engine too once it admits.
+    r = await http.post(
+        "/v1/completions",
+        json=_completion_body(),
+        headers={"X-Request-Priority": "low"},
+    )
+    assert r.status == 200
+    assert engine.requests[-1]["priority"] == PRIORITY_LOW
+    r = await http.post(
+        "/v1/completions", json=_completion_body(priority="urgent")
+    )
+    assert r.status == 400
+    assert "invalid priority" in (await r.json())["error"]["message"]
+    await http.close()
+
+
+# ------------------------------------------------- scheduler queue reaping
+def _make_scheduler(num_pages=32):
+    from dynamo_exp_tpu.engine import EngineConfig, KvPageManager
+    from dynamo_exp_tpu.engine.scheduler import Scheduler
+    from dynamo_exp_tpu.models import TINY
+
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=4,
+        page_size=PS,
+        num_pages=num_pages,
+        max_model_len=128,
+        eos_token_ids=[],
+    )
+    return Scheduler(cfg, KvPageManager(num_pages, PS))
+
+
+def _make_seq(prompt, emitted, cancelled=None, **kw):
+    from dynamo_exp_tpu.engine.scheduler import Sequence
+
+    cancelled = cancelled or (lambda: False)
+    return Sequence(
+        request_id=f"r{id(prompt) % 1000}",
+        prompt=list(prompt),
+        stop=BackendInput(token_ids=list(prompt)),
+        emit=lambda toks, reason, lp=None: emitted.append((toks, reason)),
+        is_cancelled=cancelled,
+        **kw,
+    )
+
+
+def test_scheduler_reaps_cancelled_and_expired_anywhere_in_queue():
+    """Satellite acceptance: dead requests leave the waiting deque from
+    any position — queue-depth gauges and admission bounds no longer
+    count them, and no prefill is wasted on them."""
+    from dynamo_exp_tpu.protocols.common import FinishReason
+
+    sched = _make_scheduler()
+    emitted = []
+    cancelled_flag = {"mid": False}
+    head = _make_seq([1, 2, 3], emitted)
+    mid = _make_seq([4, 5, 6], emitted, cancelled=lambda: cancelled_flag["mid"])
+    expired = _make_seq([7, 8, 9], emitted, deadline_unix=time.time() - 1.0)
+    tail = _make_seq([10, 11, 12], emitted)
+    for s in (head, mid, expired, tail):
+        sched.submit(s)
+
+    counter = get_telemetry().deadline_exceeded.labels("engine_admission")
+    before = counter._value.get()
+    cancelled_flag["mid"] = True
+    assert sched.reap_waiting() == 2
+    assert list(sched.waiting) == [head, tail]  # order preserved
+    assert counter._value.get() == before + 1
+    reasons = [r for _, r in emitted]
+    assert FinishReason.CANCELLED in reasons and FinishReason.ERROR in reasons
+    # Queue-depth gauge reflects only live work.
+    assert sched.metrics()["num_requests_waiting"] == 2
+
+
+# ------------------------------------------------ preemption victim policy
+def test_preemption_victim_lowest_priority_then_youngest():
+    from dynamo_exp_tpu.engine.scheduler import SeqState
+
+    sched = _make_scheduler()
+    emitted = []
+    seqs = [
+        _make_seq([1], emitted, priority=1, submitted_at=100.0),
+        _make_seq([2], emitted, priority=0, submitted_at=50.0),
+        _make_seq([3], emitted, priority=0, submitted_at=60.0),
+        _make_seq([4], emitted, priority=2, submitted_at=10.0),
+    ]
+    for i, s in enumerate(seqs):
+        s.state = SeqState.ACTIVE
+        s.slot = i
+        sched.slots[i] = s
+    sched.active_count = 4
+
+    # Lowest priority wins; among the two lows, the youngest (latest
+    # submitted) is evicted — least sunk cost, weakest claim.
+    assert sched.preemption_victim(max_preemptions=2) is seqs[2]
+    # The bound exempts sequences already preempted enough.
+    seqs[2].preemptions = 2
+    assert sched.preemption_victim(max_preemptions=2) is seqs[1]
+    seqs[1].preemptions = 2
+    assert sched.preemption_victim(max_preemptions=2) is seqs[0]
+    for s in seqs:
+        s.preemptions = 2
+    assert sched.preemption_victim(max_preemptions=2) is None
+
+
+def test_preempt_requeues_deterministic_continuation():
+    """State surgery: a preempted ACTIVE sequence releases its slot and
+    pages and re-enters the waiting deque (at the back) as a
+    continuation — full context as prompt, budget reduced, cumulative
+    resume_offset, same sampling seed."""
+    from dynamo_exp_tpu.engine.scheduler import SeqState
+
+    sched = _make_scheduler()
+    emitted = []
+    seq = _make_seq(list(range(1, 11)), emitted, sample_seed=42)
+    seq.stop.stop_conditions.max_tokens = 20
+    seq.stop.stop_conditions.min_tokens = 8
+    sched.submit(seq)
+    assert sched.admit_next() is seq
+    pages_before = sched.kv.active_pages
+    assert pages_before > 0
+    seq.state = SeqState.ACTIVE
+    seq.tokens = list(range(1, 11)) + [91, 92, 93]  # 3 generated
+    seq.generated = 3
+
+    other = _make_seq([5, 6], emitted)
+    sched.submit(other)
+    sched.preempt(seq)
+
+    assert seq.state is SeqState.WAITING
+    assert sched.active_count == 0 and sched.slots == [None] * 4
+    assert sched.kv.active_pages == 0  # pages released (parked/free)
+    assert list(sched.waiting) == [other, seq]  # back of the queue
+    assert seq.prompt == list(range(1, 11)) + [91, 92, 93]
+    assert seq.stop.token_ids == seq.prompt
+    assert seq.stop.resume_offset == 3
+    assert seq.stop.stop_conditions.max_tokens == 17
+    assert seq.stop.stop_conditions.min_tokens == 5
+    assert seq.sample_seed == 42 and seq.preemptions == 1
+    assert seq.generated == 0 and seq.page_ids == []
+    # A second preemption accumulates the resume offset.
+    assert sched.admit_next() is other  # FIFO: other first
+    sched.waiting.clear()
+    seq.state = SeqState.ACTIVE
+    seq.slot = 1
+    sched.slots[1] = seq
+    sched.active_count += 1
+    seq.tokens = seq.prompt + [94, 95]
+    seq.generated = 2
+    sched.preempt(seq)
+    assert seq.stop.resume_offset == 5
+    assert seq.stop.stop_conditions.max_tokens == 15
+
+
+# ----------------------------------------------- engine: preempt + resume
+def _engine(num_pages, grace=0.05):
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models import TINY
+    from dynamo_exp_tpu.parallel import single_device_mesh
+
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=4,
+        page_size=PS,
+        num_pages=num_pages,
+        max_model_len=128,
+        eos_token_ids=[],
+        kv_dtype="float32",
+        preempt_stall_grace_s=grace,
+    )
+    return TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+
+
+@pytest.fixture(scope="module")
+def pressure_engine():
+    """8-page pool: two 8-token prompts decoding 40 tokens each need 12
+    pages — guaranteed KV pressure, guaranteed preemption — while a
+    single request (6 pages) fits alone. Oracle runs therefore
+    execute *sequentially on the same engine* (one request alone never
+    stalls, and counter-based sampling makes tokens a pure function of
+    the request, not the pool), sharing its compiled variants."""
+    eng = _engine(num_pages=8)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+async def _run(eng, prompt, max_tokens, ctx=None, priority=1, **sampling):
+    b = BackendInput(token_ids=list(prompt), priority=priority)
+    b.stop_conditions.max_tokens = max_tokens
+    b.stop_conditions.ignore_eos = True
+    if sampling:
+        b.sampling_options = SamplingOptions(**sampling)
+    stream = await eng.generate(b.to_dict(), ctx)
+    tokens, final = [], None
+    async for item in stream:
+        tokens.extend(item.get("token_ids", []))
+        if item.get("finish_reason"):
+            final = item
+    return tokens, final
+
+
+P1 = [5, 9, 17, 23, 4, 31, 8, 2]
+P2 = [7, 3, 19, 28, 41, 13, 6, 11]
+N = 40
+
+
+async def test_preempt_resume_greedy_token_identity(pressure_engine):
+    """Tentpole acceptance (greedy): under an 8-page pool two concurrent
+    requests force a preemption; both streams still complete
+    token-identical to uninterrupted (sequential, pressure-free) runs."""
+    o1, _ = await _run(pressure_engine, P1, N)
+    o2, _ = await _run(pressure_engine, P2, N)
+    before = pressure_engine.preempted
+    (r1, f1), (r2, f2) = await asyncio.gather(
+        _run(pressure_engine, P1, N), _run(pressure_engine, P2, N)
+    )
+    assert pressure_engine.preempted > before  # pressure actually bit
+    assert r1 == o1 and r2 == o2
+    assert f1["finish_reason"] == "length" and f2["finish_reason"] == "length"
+    # Usage is the client's view: the re-prefilled continuation doesn't
+    # shrink the completion count.
+    assert f1["completion_tokens"] == N and f2["completion_tokens"] == N
+    assert f1["prompt_tokens"] == len(P1)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+async def test_preempt_resume_seeded_token_identity(pressure_engine, seed):
+    """Tentpole acceptance (seeded sampling): counter-based draws keyed
+    by (seed, absolute position) make the preempted-and-resumed stream
+    bit-identical to the uninterrupted run, for every chaos seed."""
+    so1 = dict(temperature=0.9, top_p=0.9, seed=seed)
+    so2 = dict(temperature=0.8, seed=seed + 1)
+    o1, _ = await _run(pressure_engine, P1, N, **so1)
+    o2, _ = await _run(pressure_engine, P2, N, **so2)
+    before = pressure_engine.preempted
+    (r1, _), (r2, _) = await asyncio.gather(
+        _run(pressure_engine, P1, N, **so1),
+        _run(pressure_engine, P2, N, **so2),
+    )
+    assert pressure_engine.preempted > before
+    assert r1 == o1 and r2 == o2
+
+
+async def test_preempt_resume_penalized_restores_counts(pressure_engine):
+    """Penalty counts rebuild from the cumulative resume_offset at
+    re-prefill, so post-splice draws see the counts the uninterrupted
+    run would have."""
+    so = dict(presence_penalty=5.0)
+    o1, _ = await _run(pressure_engine, P1, N, **so)
+    o2, _ = await _run(pressure_engine, P2, N, **so)
+    before = pressure_engine.preempted
+    (r1, _), (r2, _) = await asyncio.gather(
+        _run(pressure_engine, P1, N, **so),
+        _run(pressure_engine, P2, N, **so),
+    )
+    assert pressure_engine.preempted > before
+    assert r1 == o1 and r2 == o2
+
+
+async def test_capacity_exceeding_requests_finish_instead_of_hanging(
+    pressure_engine,
+):
+    """A request whose context outgrows the ENTIRE pool can never be
+    fed its next token — no preemption or wait helps. The engine must
+    close the stream at the pool's context capacity (finish=length,
+    mirroring max_model_len) instead of stalling the slot forever; a
+    prompt that alone exceeds the pool is rejected at admission. Both
+    were permanent hangs reachable via preemption-grown continuation
+    prompts."""
+    eng = pressure_engine
+    capacity = eng.cfg.num_pages * PS  # 64 tokens of KV
+    prompt = [5, 9, 17, 23, 4, 31]
+    # Budget far past capacity, concurrently (so preemption also churns).
+    (n1, f1), (n2, f2) = await asyncio.gather(
+        _run(eng, prompt, 60), _run(eng, [7, 3, 19, 28, 41, 13], 60)
+    )
+    assert f1["finish_reason"] == "length" and f2["finish_reason"] == "length"
+    # Everything the pool could hold was delivered (the final sampled
+    # token rides out without its KV ever being written).
+    assert len(n1) == capacity - len(prompt) + 1
+    assert len(n2) == capacity - len(prompt) + 1
+    # Prompt alone larger than the pool: immediate error, not a wait.
+    toks, final = await asyncio.wait_for(
+        _run(eng, list(range(3, 3 + capacity + 6)), 4), timeout=30
+    )
+    assert toks == [] and final["finish_reason"] == "error"
+
+
+async def test_engine_drops_expired_at_admission(pressure_engine):
+    """Satellite acceptance: a request whose deadline already passed is
+    reaped from the waiting queue before prefill, counted under
+    dynamo_deadline_exceeded_total{stage="engine_admission"}."""
+    counter = get_telemetry().deadline_exceeded.labels("engine_admission")
+    before = counter._value.get()
+    ctx = AsyncEngineContext()
+    ctx.deadline = time.time() - 0.5  # already expired
+    tokens, final = await _run(pressure_engine, P1, 4, ctx=ctx)
+    assert tokens == []
+    assert final["finish_reason"] == "error"
+    assert counter._value.get() == before + 1
+
+
+async def test_preemption_disabled_by_negative_grace(pressure_engine):
+    """grace < 0 restores the old park-forever behavior (no preemption),
+    proving the trigger is the grace clock and nothing else. The knob is
+    flipped live (the loop reads it every iteration), then restored so
+    the parked scenario drains normally."""
+    eng = pressure_engine
+    old_grace = eng.cfg.preempt_stall_grace_s
+    eng.cfg.preempt_stall_grace_s = -1.0
+    before = eng.preempted
+    task = asyncio.ensure_future(
+        asyncio.gather(_run(eng, P1, N), _run(eng, P2, N))
+    )
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if eng.metrics()["request_stalled_slots"] >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert eng.metrics()["request_stalled_slots"] >= 2
+        await asyncio.sleep(0.3)  # well past the usual grace
+        assert eng.preempted == before
+        assert not task.done()  # both rows park forever
+    finally:
+        eng.cfg.preempt_stall_grace_s = old_grace
+    # Preemption re-enabled: the parked overload drains to completion.
+    (r1, _), (r2, _) = await task
+    assert len(r1) == N and len(r2) == N
+
+
+def test_engine_enforces_deadline_on_bound_rows():
+    """A bound (ACTIVE) row whose deadline expires is finished and
+    released — a row stalled at its preemption bound must not hold its
+    slot and pages until the client disconnects. Unit-level: the engine
+    is never started, so the loop thread can't race the hand-crafted
+    slot state."""
+    from dynamo_exp_tpu.engine.scheduler import SeqState
+    from dynamo_exp_tpu.protocols.common import FinishReason
+
+    eng = _engine(num_pages=8)  # constructed only — no loop thread
+    emitted = []
+    live = _make_seq([1, 2, 3], emitted, deadline_unix=time.time() + 60)
+    dead = _make_seq([4, 5, 6], emitted, deadline_unix=time.time() - 1.0)
+    for i, s in enumerate((live, dead)):
+        s.state = SeqState.ACTIVE
+        s.slot = i
+        eng.sched.slots[i] = s
+    eng.sched.active_count = 2
+
+    counter = get_telemetry().deadline_exceeded.labels("decode")
+    before = counter._value.get()
+    eng._poll_cancellations()
+    assert dead.state is SeqState.FINISHED
+    assert emitted == [([], FinishReason.ERROR)]
+    assert live.state is SeqState.ACTIVE  # unexpired row untouched
+    assert counter._value.get() == before + 1
+
+
+# ------------------------------------------------------ load-aware routing
+def test_load_penalty_routes_away_from_deep_queues():
+    """Satellite acceptance: equal overlap and equal decode occupancy,
+    but one instance has a deep waiting queue — the queue-depth penalty
+    sheds the request toward the idle instance."""
+    from dynamo_exp_tpu.kv_router.protocols import (
+        ForwardPassMetrics,
+        OverlapScores,
+    )
+    from dynamo_exp_tpu.kv_router.scheduler import (
+        DefaultWorkerSelector,
+        ProcessedEndpoints,
+    )
+
+    eps = ProcessedEndpoints(
+        metrics={
+            1: ForwardPassMetrics(
+                request_active_slots=4,
+                request_total_slots=8,
+                num_requests_waiting=16,
+            ),
+            2: ForwardPassMetrics(
+                request_active_slots=4, request_total_slots=8
+            ),
+        }
+    )
+    sel = DefaultWorkerSelector(rng=random.Random(0))
+    wid, _ = sel.select_worker(eps, OverlapScores({1: 2, 2: 2}), 64, 8)
+    assert wid == 2
+
+    # A big-enough overlap advantage still beats a moderate backlog
+    # (the 2x overlap term keeps KV-aware routing KV-aware).
+    eps.metrics[1].num_requests_waiting = 4
+    wid, _ = sel.select_worker(eps, OverlapScores({1: 8, 2: 0}), 64, 8)
+    assert wid == 1
+
+    # queue_weight=0 restores the reference cost exactly: the deep
+    # queue becomes invisible and the workers tie.
+    eps.metrics[1].num_requests_waiting = 100
+    flat = DefaultWorkerSelector(rng=random.Random(0), queue_weight=0.0)
+    picks = {
+        flat.select_worker(eps, OverlapScores({1: 2, 2: 2}), 64, 8)[0]
+        for _ in range(16)
+    }
+    assert picks == {1, 2}
+
+
+# ------------------------------------------- overload_burst (acceptance)
+@pytest.mark.parametrize("seed", SEEDS)
+async def test_overload_burst_no_hangs_sheds_tagged_streams_identical(
+    pressure_engine, seed
+):
+    """Acceptance: a seeded mixed-priority burst against an 8-page pool.
+    No request hangs: every admitted stream finishes (preempted-and-
+    resumed streams token-identically — asserted against uninterrupted
+    oracle runs), every shed request carries a 429/503 status, and the
+    scenario itself is deterministic per seed."""
+    burst = overload_burst(seed, n=8, osl_range=(6, 12))
+    assert [
+        (b.priority, b.prompt, b.max_tokens, b.seed) for b in burst
+    ] == [
+        (b.priority, b.prompt, b.max_tokens, b.seed)
+        for b in overload_burst(seed, n=8, osl_range=(6, 12))
+    ]  # seeded scenario: bit-identical across runs
+
+    oracles = {}
+    for b in burst:  # sequential = pressure-free: each is its own oracle
+        toks, _ = await _run(
+            pressure_engine, b.prompt, b.max_tokens,
+            temperature=0.9, seed=b.seed,
+        )
+        oracles[b.index] = toks
+
+    adm = AdmissionController(max_inflight=6, shed_watermark=3)
+
+    async def submit(b):
+        try:
+            adm.acquire(parse_priority(b.priority))
+        except RequestShedError as e:
+            return ("shed", e.status, None)
+        try:
+            toks, final = await _run(
+                pressure_engine, b.prompt, b.max_tokens,
+                priority=parse_priority(b.priority),
+                temperature=0.9, seed=b.seed,
+            )
+            return ("done", final["finish_reason"], toks)
+        finally:
+            adm.release()
+
+    results = await asyncio.wait_for(
+        asyncio.gather(*[submit(b) for b in burst]), timeout=90
+    )
+
+    assert adm.inflight == 0
+    done = [i for i, r in enumerate(results) if r[0] == "done"]
+    shed = [i for i, r in enumerate(results) if r[0] == "shed"]
+    assert len(done) + len(shed) == len(burst)  # nothing hung or vanished
+    assert done  # the burst was not shed wholesale
+    for i in done:
+        assert results[i][1] == "length"
+        assert results[i][2] == oracles[i], f"stream {i} diverged"
+    for i in shed:
+        assert results[i][1] in (429, 503)
