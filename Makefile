@@ -13,8 +13,12 @@ RECOVERY_SEED_SETS := 7,21,1337 5,8,13
 # bursts against a tiny KV pool) driving edge shedding + KV-pressure
 # preemption in tests/test_overload.py.
 OVERLOAD_SEED_SETS := 7,21,1337 3,9,27
+# Simulation seed sets: the discrete-event cluster simulator's
+# regression runs (determinism, calibration vs the live overload
+# harness, reactive-vs-SLO planner comparison) in tests/test_sim.py.
+SIM_SEED_SETS := 7,21,1337 3,9,27
 
-.PHONY: test pre-merge nightly chaos lint
+.PHONY: test pre-merge nightly chaos sim sim-scale lint
 
 test:
 	$(PYTEST) tests/ -q -m "not tpu and not weekly"
@@ -42,6 +46,19 @@ chaos:
 		echo "=== overload suite, CHAOS_SEEDS=$$seeds ==="; \
 		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_overload.py -q -m chaos; \
 	done
+
+# Seeded simulator regression sets (mirrors `make chaos`): every seed
+# set re-runs the sim suite — determinism and calibration must hold for
+# each (docs/simulation.md). The marked-slow fleet-scale runs are
+# excluded here; `make sim-scale` runs them.
+sim:
+	@set -e; for seeds in $(SIM_SEED_SETS); do \
+		echo "=== sim suite, SIM_SEEDS=$$seeds ==="; \
+		env SIM_SEEDS=$$seeds $(PYTEST) tests/test_sim.py -q -m "sim and not slow"; \
+	done
+
+sim-scale:
+	$(PYTEST) tests/test_sim.py -q -m "sim and slow"
 
 lint:
 	ruff check dynamo_exp_tpu/ tests/ bench.py __graft_entry__.py

@@ -30,11 +30,56 @@ MODEL = "llama-1b"
 ISL = 128
 OSL = 64
 CONCURRENCY = 32
+# The CPU fallback's default point: a 1B model at the TPU shape is
+# hours on a CI box's cores, which reads as a dead bench run — and even
+# the trimmed shape is minutes of f32 weight init + compile there, so
+# the fallback also drops to the ``tiny`` preset. The metric name
+# carries the model and shape and every line carries the platform, so
+# the trajectory stays unambiguous. Explicit --model/--isl/--osl/
+# --concurrency always win.
+CPU_MODEL = "tiny"
+CPU_ISL = 64
+CPU_OSL = 32
+CPU_CONCURRENCY = 4
 HBM_GBPS = 819.0  # TPU v5e
 
 SWEEP_ISL = 3000
 SWEEP_OSL = 150
 SWEEP_CONCURRENCY = (1, 4, 16, 32)
+# CPU-fallback sweep shapes: the reference sweep point (ISL 3000 at
+# concurrency 32) is the "hours on a CI box" case above even with the
+# tiny preset, so every sweep mode trims the same way the default
+# point does — the emitted shape labels + platform tag keep fallback
+# lines distinguishable from chip lines.
+CPU_SWEEP_ISL = 256
+CPU_SWEEP_OSL = 32
+CPU_SWEEP_CONCURRENCY = (1, 2, 4)
+CPU_SWEEP_KW = dict(slots=4, isl=128, osl=32)  # occupancy/overload sweeps
+CPU_OVERLOAD_BURSTS = (4, 8, 16)
+CPU_PREFIX_KW = dict(isl=256, osl=8, concurrency=4)
+
+# Burst policy: warmup rounds (compile + program load) and timed rounds
+# (best-of). The CPU fallback trims both to 1 — XLA:CPU timings are
+# low-variance and a 1B-model burst is minutes, not seconds, there.
+WARMUP_BURSTS = 2
+TIMED_BURSTS = 3
+# Set by the probe's CPU fallback: run the model in float32 there
+# (XLA:CPU software-emulates bfloat16 matmuls — order-of-magnitude
+# slower than native f32 on the same cores).
+CPU_FALLBACK = False
+
+
+def _preset(name: str):
+    from dataclasses import replace
+
+    from dynamo_exp_tpu.models import PRESETS
+
+    mcfg = PRESETS[name]
+    return replace(mcfg, dtype="float32") if CPU_FALLBACK else mcfg
+
+
+def _kv_dtype() -> str:
+    return "float32" if CPU_FALLBACK else "bfloat16"
 
 
 def _roofline_tok_s(params, batch: int) -> float:
@@ -65,12 +110,11 @@ def _enable_compile_cache() -> None:
 def run_point(isl: int, osl: int, concurrency: int) -> dict:
     """One measured point: build an engine, double-warm, time a burst."""
     from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
-    from dynamo_exp_tpu.models import PRESETS
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
 
-    mcfg = PRESETS[MODEL]
+    mcfg = _preset(MODEL)
     cfg = EngineConfig(
         model=mcfg,
         max_decode_slots=concurrency,
@@ -78,6 +122,7 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
         num_pages=concurrency * ((isl + osl) // 16 + 2) + 64,
         max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
         eos_token_ids=[],
+        kv_dtype=_kv_dtype(),
         # One host sync per 32 decode steps: throughput benches are
         # sync-bound long before they are FLOP-bound on a tunneled chip.
         decode_window=32,
@@ -119,13 +164,13 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
         # matters because the tunnel's AOT compile path also makes the
         # *second* execution of a fresh executable slow (program load).
         # Steady-state throughput, not compile/load time, is the metric.
-        for _ in range(2):
+        for _ in range(WARMUP_BURSTS):
             await asyncio.gather(*[run_one(p) for p in warmups])
         # Best of three timed bursts: the tunneled chip's latency is
         # high-variance, and peak steady-state is the honest capability
         # number a flaky link can still demonstrate.
         best = None
-        for burst_prompts in (fresh_prompts() for _ in range(3)):
+        for burst_prompts in (fresh_prompts() for _ in range(TIMED_BURSTS)):
             t0 = time.perf_counter()
             results = await asyncio.gather(*[run_one(p) for p in burst_prompts])
             dt = time.perf_counter() - t0
@@ -162,11 +207,10 @@ def run_occupancy_sweep(
     import asyncio
 
     from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
-    from dynamo_exp_tpu.models import PRESETS
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
-    mcfg = PRESETS[MODEL]
+    mcfg = _preset(MODEL)
     cfg = EngineConfig(
         model=mcfg,
         max_decode_slots=slots,
@@ -174,6 +218,7 @@ def run_occupancy_sweep(
         num_pages=slots * ((isl + osl) // 16 + 2) + 64,
         max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
         eos_token_ids=[],
+        kv_dtype=_kv_dtype(),
         decode_window=32,
     )
     engine = TPUEngine(cfg, seed=0)
@@ -199,10 +244,10 @@ def run_occupancy_sweep(
     async def point(active: int) -> float:
         # Double warmup per occupancy (compile + program load), then
         # best-of-three timed bursts (same policy as run_point).
-        for _ in range(2):
+        for _ in range(WARMUP_BURSTS):
             await asyncio.gather(*[run_one(p) for p in prompts(active)])
         best = 0.0
-        for _ in range(3):
+        for _ in range(TIMED_BURSTS):
             batch = prompts(active)
             t0 = time.perf_counter()
             results = await asyncio.gather(*[run_one(p) for p in batch])
@@ -263,11 +308,10 @@ def run_overload_sweep(
         RequestShedError,
         parse_priority,
     )
-    from dynamo_exp_tpu.models import PRESETS
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
-    mcfg = PRESETS[MODEL]
+    mcfg = _preset(MODEL)
     pages_per_seq = (isl + osl) // 16 + 2
     cfg = EngineConfig(
         model=mcfg,
@@ -276,6 +320,7 @@ def run_overload_sweep(
         num_pages=(slots * pages_per_seq) // 2 + 16,  # deliberate pressure
         max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
         eos_token_ids=[],
+        kv_dtype=_kv_dtype(),
         decode_window=32,
         preempt_stall_grace_s=0.2,
     )
@@ -372,11 +417,10 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
     import asyncio
 
     from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
-    from dynamo_exp_tpu.models import PRESETS
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
-    mcfg = PRESETS[MODEL]
+    mcfg = _preset(MODEL)
     cfg = EngineConfig(
         model=mcfg,
         max_decode_slots=concurrency,
@@ -384,6 +428,7 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
         num_pages=concurrency * ((isl + osl) // 16 + 2) + 256,
         max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
         eos_token_ids=[],
+        kv_dtype=_kv_dtype(),
         decode_window=8,
     )
     engine = TPUEngine(cfg, seed=0)
@@ -436,29 +481,66 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
     }
 
 
-def _probe_device(timeout_s: float = 180.0) -> None:
-    """Fail fast (clear error, rc=1) when the accelerator backend is
-    unreachable — jax.devices() against a dead TPU tunnel blocks
-    indefinitely, which would otherwise hang the whole bench run."""
+def _fall_back_to_cpu(reason: str) -> str:
+    """Pin this process (and its children) to the XLA CPU backend.
+    Env var for anything imported later, config update in case a
+    sitecustomize already registered an accelerator plugin as default
+    (the same two-step pin tier-1's conftest uses)."""
+    import os
+    import sys
+
+    print(f"bench: {reason}; falling back to JAX_PLATFORMS=cpu", file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
+def _probe_device(timeout_s: float = 180.0) -> str:
+    """Probe the accelerator backend in a subprocess — jax.devices()
+    against a dead TPU tunnel blocks indefinitely, which would otherwise
+    hang the whole bench run. Unreachable (timeout or init error) is not
+    fatal: fall back to the CPU backend so the perf trajectory keeps
+    recording (each JSON line is tagged with the platform actually
+    used). Returns that platform name."""
+    import os
     import subprocess
     import sys
 
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return _fall_back_to_cpu("JAX_PLATFORMS=cpu requested")
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", timeout_s))
     try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(jax.devices()[0].platform)",
+            ],
             timeout=timeout_s,
             check=True,
             capture_output=True,
         )
     except subprocess.TimeoutExpired:
-        raise SystemExit(
+        return _fall_back_to_cpu(
             f"accelerator backend unreachable (device init exceeded "
             f"{timeout_s:.0f}s) — TPU tunnel down?"
-        ) from None
+        )
     except subprocess.CalledProcessError as e:
-        raise SystemExit(
+        return _fall_back_to_cpu(
             f"device init failed: {e.stderr.decode(errors='replace')[-500:]}"
-        ) from None
+        )
+    lines = out.stdout.decode(errors="replace").strip().splitlines()
+    platform = lines[-1].strip() if lines else ""
+    if platform not in ("cpu", "tpu", "gpu", "cuda", "rocm"):
+        # Probe exited 0 but reported nothing recognizable: an
+        # unverified backend must not get the full TPU-shape run —
+        # that's the hours-long "dead bench" this fallback prevents.
+        return _fall_back_to_cpu(
+            f"device probe returned unrecognized platform {platform!r}"
+        )
+    return platform
 
 
 def main() -> None:
@@ -487,23 +569,54 @@ def main() -> None:
         "burst level against a pressure-sized pool (graceful "
         "degradation curve)",
     )
-    ap.add_argument("--model", default=MODEL, help="preset name")
+    ap.add_argument(
+        "--model",
+        default=None,
+        help=f"preset name (default {MODEL}; {CPU_MODEL} on CPU fallback)",
+    )
+    # Default-point shape overrides (smoke tests run a tiny point; the
+    # metric name carries the shape, so overridden runs stay labeled).
+    # None = not given: the default resolves per platform after the
+    # probe, but an explicit flag always wins, even on CPU fallback.
+    ap.add_argument("--isl", type=int, default=None)
+    ap.add_argument("--osl", type=int, default=None)
+    ap.add_argument("--concurrency", type=int, default=None)
     args = ap.parse_args()
-    MODEL = args.model
-    _probe_device()
+    platform = _probe_device()
+    if platform == "cpu":
+        global CPU_FALLBACK, WARMUP_BURSTS, TIMED_BURSTS
+        CPU_FALLBACK = True
+        WARMUP_BURSTS = TIMED_BURSTS = 1
+    MODEL = args.model or (CPU_MODEL if platform == "cpu" else MODEL)
+    if args.isl is None:
+        args.isl = CPU_ISL if platform == "cpu" else ISL
+    if args.osl is None:
+        args.osl = CPU_OSL if platform == "cpu" else OSL
+    if args.concurrency is None:
+        args.concurrency = CPU_CONCURRENCY if platform == "cpu" else CONCURRENCY
+
+    def emit(point: dict) -> None:
+        print(json.dumps(point | {"platform": platform}), flush=True)
+
+    cpu = platform == "cpu"
     if args.sweep:
-        for c in SWEEP_CONCURRENCY:
-            print(json.dumps(run_point(SWEEP_ISL, SWEEP_OSL, c)), flush=True)
+        s_isl = CPU_SWEEP_ISL if cpu else SWEEP_ISL
+        s_osl = CPU_SWEEP_OSL if cpu else SWEEP_OSL
+        for c in CPU_SWEEP_CONCURRENCY if cpu else SWEEP_CONCURRENCY:
+            emit(run_point(s_isl, s_osl, c))
     elif args.occupancy_sweep:
-        for point in run_occupancy_sweep():
-            print(json.dumps(point), flush=True)
+        for point in run_occupancy_sweep(**(CPU_SWEEP_KW if cpu else {})):
+            emit(point)
     elif args.overload_sweep:
-        for point in run_overload_sweep():
-            print(json.dumps(point), flush=True)
+        kw = (
+            dict(CPU_SWEEP_KW, burst_levels=CPU_OVERLOAD_BURSTS) if cpu else {}
+        )
+        for point in run_overload_sweep(**kw):
+            emit(point)
     elif args.prefix_reuse:
-        print(json.dumps(run_prefix_reuse()))
+        emit(run_prefix_reuse(**(CPU_PREFIX_KW if cpu else {})))
     else:
-        print(json.dumps(run_point(ISL, OSL, CONCURRENCY)))
+        emit(run_point(args.isl, args.osl, args.concurrency))
 
 
 if __name__ == "__main__":

@@ -136,6 +136,33 @@ class Sequence:
         return self.tokens[-1]
 
 
+def select_preemption_victim(candidates, max_preemptions: int):
+    """Pure KV-pressure victim policy: lowest priority first, youngest
+    (latest-submitted) on ties — the work with the least sunk cost and
+    the weakest claim. Sequences at their preemption bound are exempt
+    (they would otherwise live-lock re-prefilling forever), as are
+    extract-mode sequences (disagg prefill workers: their one token is
+    already sampled) and rows with a deferred finish in flight.
+
+    Shared verbatim by the engine scheduler and the cluster simulator
+    (``dynamo_exp_tpu/sim/``): ``candidates`` is any iterable of
+    objects with the Sequence policy surface (``state``,
+    ``pending_finish``, ``extract_cb``, ``preemptions``, ``priority``,
+    ``submitted_at``). Returns None when nothing qualifies."""
+    eligible = [
+        s
+        for s in candidates
+        if s is not None
+        and s.state is SeqState.ACTIVE
+        and s.pending_finish is None
+        and s.extract_cb is None
+        and s.preemptions < max_preemptions
+    ]
+    if not eligible:
+        return None
+    return min(eligible, key=lambda s: (s.priority, -s.submitted_at))
+
+
 class Scheduler:
     def __init__(self, cfg: EngineConfig, kv: KvPageManager):
         self.cfg = cfg
@@ -335,25 +362,9 @@ class Scheduler:
 
     # ------------------------------------------------------------ preemption
     def preemption_victim(self, max_preemptions: int) -> Sequence | None:
-        """The sequence KV-pressure preemption evicts next: lowest
-        priority first, youngest (latest-submitted) on ties — the work
-        with the least sunk cost and the weakest claim. Sequences at
-        their preemption bound are exempt (they would otherwise
-        live-lock re-prefilling forever), as are extract-mode sequences
-        (disagg prefill workers: their one token is already sampled).
-        Returns None when nothing qualifies."""
-        candidates = [
-            s
-            for s in self.slots
-            if s is not None
-            and s.state is SeqState.ACTIVE
-            and s.pending_finish is None
-            and s.extract_cb is None
-            and s.preemptions < max_preemptions
-        ]
-        if not candidates:
-            return None
-        return min(candidates, key=lambda s: (s.priority, -s.submitted_at))
+        """The sequence KV-pressure preemption evicts next (policy in
+        :func:`select_preemption_victim`, shared with the simulator)."""
+        return select_preemption_victim(self.slots, max_preemptions)
 
     def preempt(self, seq: Sequence) -> None:
         """Unbind an ACTIVE sequence from its slot, release its pages,

@@ -51,6 +51,16 @@ class ServiceOverloadedError(RequestShedError):
     status = 503
 
 
+def _resolve_watermark(max_inflight: int, shed_watermark: int | None) -> int:
+    """Default high watermark: 3/4 of the cap (at least 1 so the
+    graduated band exists); an explicit value is clamped to the cap.
+    Shared by the constructor and :meth:`AdmissionController.resize` so
+    the policy can't silently diverge between the two."""
+    if shed_watermark is not None:
+        return min(shed_watermark, max_inflight)
+    return max((max_inflight * 3) // 4, 1)
+
+
 class AdmissionController:
     """Per-service in-flight bound with priority-graduated shedding.
 
@@ -68,13 +78,7 @@ class AdmissionController:
         if max_inflight < 1:
             raise ValueError("max_inflight must be >= 1")
         self.max_inflight = max_inflight
-        # Default high watermark: 3/4 of the cap (at least 1 below it so
-        # the graduated band exists).
-        self.shed_watermark = (
-            min(shed_watermark, max_inflight)
-            if shed_watermark is not None
-            else max((max_inflight * 3) // 4, 1)
-        )
+        self.shed_watermark = _resolve_watermark(max_inflight, shed_watermark)
         self.retry_after_s = retry_after_s
         self._inflight = 0
         self._lock = threading.Lock()
@@ -86,6 +90,19 @@ class AdmissionController:
     @property
     def inflight(self) -> int:
         return self._inflight
+
+    def resize(self, max_inflight: int, shed_watermark: int | None = None) -> None:
+        """Move the bounds on a live controller (the cluster simulator
+        and autoscaled deployments scale the admission budget with the
+        fleet). In-flight work above a shrunk cap is never shed — the
+        new bounds apply to future acquires only."""
+        if max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        with self._lock:
+            self.max_inflight = max_inflight
+            self.shed_watermark = _resolve_watermark(
+                max_inflight, shed_watermark
+            )
 
     def threshold(self, priority: int) -> int:
         """The in-flight level at which ``priority`` stops being

@@ -194,6 +194,52 @@ def build_parser() -> argparse.ArgumentParser:
         help="recorder JSONL path(s); defaults to $DYN_TRACE_FILE "
              "(rotated generations are read automatically)",
     )
+
+    # Offline cluster simulation (docs/simulation.md): replay a seeded
+    # workload through the real admission/routing/preemption/planner
+    # policy code against modeled instances and print the SimReport.
+    sim = sub.add_parser(
+        "sim", help="discrete-event cluster simulation (offline, seeded)"
+    )
+    sim.add_argument(
+        "workload",
+        choices=("burst", "ramp", "users"),
+        help="burst: the overload_burst chaos scenario; ramp: linear "
+        "arrival-rate ramp; users: open-loop synthetic user stream",
+    )
+    sim.add_argument("--seed", type=int, default=0)
+    sim.add_argument("--requests", type=int, default=None,
+                     help="request count (burst n / users cap)")
+    sim.add_argument("--duration-s", type=float, default=300.0)
+    sim.add_argument("--rps-start", type=float, default=1.0)
+    sim.add_argument("--rps-end", type=float, default=12.0)
+    sim.add_argument("--trace-out", default="",
+                     help="also save the workload as a JSONL trace file")
+    sim.add_argument("--trace-in", default="",
+                     help="replay a JSONL trace file instead of generating "
+                     "(overrides the workload kind)")
+    sim.add_argument("--instances", type=int, default=1)
+    sim.add_argument("--slots", type=int, default=8)
+    sim.add_argument("--pages", type=int, default=256)
+    sim.add_argument("--page-size", type=int, default=16)
+    sim.add_argument("--max-inflight", type=int, default=64)
+    sim.add_argument("--shed-watermark", type=int, default=None)
+    sim.add_argument(
+        "--planner", choices=("none", "reactive", "slo"), default="none"
+    )
+    sim.add_argument("--max-tpu-budget", type=int, default=8)
+    sim.add_argument("--ttft-slo-s", type=float, default=2.0)
+    sim.add_argument("--itl-slo-s", type=float, default=0.2)
+    sim.add_argument(
+        "--fit-spans", action="append", default=[],
+        help="telemetry recorder JSONL to fit service times from",
+    )
+    sim.add_argument(
+        "--fit-bench", action="append", default=[],
+        help="bench.py JSON (or BENCH_r*.json wrapper) to fit from",
+    )
+    sim.add_argument("--events", action="store_true",
+                     help="print the event log instead of the report")
     return p
 
 
@@ -224,6 +270,84 @@ def run_trace(args) -> int:
         print(f"no trace matching {args.trace_id!r}", file=sys.stderr)
         return 1
     print(render_timeline(group))
+    return 0
+
+
+def run_sim(args) -> int:
+    from .planner import PlannerConfig, SloTargets
+    from .sim import (
+        ClusterSim,
+        ServiceTimeModel,
+        SimConfig,
+        burst_workload,
+        load_trace,
+        ramp_workload,
+        save_trace,
+        synthetic_users,
+    )
+
+    if args.trace_in:
+        workload = load_trace(args.trace_in)
+    elif args.workload == "burst":
+        workload = burst_workload(args.seed, n=args.requests or 8)
+    elif args.workload == "ramp":
+        workload = ramp_workload(
+            args.seed,
+            duration_s=args.duration_s,
+            rps_start=args.rps_start,
+            rps_end=args.rps_end,
+        )
+    else:
+        workload = synthetic_users(
+            args.seed,
+            users=args.requests or 100_000,
+            duration_s=args.duration_s,
+        )
+    if args.trace_out:
+        workload = list(workload)
+        n = save_trace(args.trace_out, workload)
+        print(f"# {n} requests -> {args.trace_out}", file=sys.stderr)
+    service = (
+        ServiceTimeModel.from_telemetry(
+            span_paths=args.fit_spans, bench_paths=args.fit_bench
+        )
+        if (args.fit_spans or args.fit_bench)
+        else ServiceTimeModel.default()
+    )
+    cfg = SimConfig(
+        seed=args.seed,
+        slots_per_instance=args.slots,
+        pages_per_instance=args.pages,
+        page_size=args.page_size,
+        max_inflight=args.max_inflight,
+        shed_watermark=args.shed_watermark,
+        admission_per_instance=args.planner != "none",
+        initial_instances=args.instances,
+        planner=None if args.planner == "none" else args.planner,
+        planner_cfg=PlannerConfig(
+            max_tpu_budget=args.max_tpu_budget, min_endpoint=1
+        ),
+        slo=SloTargets(
+            ttft_p99_slo_s=args.ttft_slo_s,
+            itl_p99_slo_s=args.itl_slo_s,
+            # Fitted-service hint: scale for where the trend will be
+            # when a new worker actually lands.
+            provision_s=service.planner_hints()["provision_s"],
+        ),
+        service=service,
+        record_events=args.events,
+    )
+    sim = ClusterSim(cfg, workload)
+    report = sim.run()
+    if args.events:
+        # Event lines own stdout (grep/diff-able stream, as the flag's
+        # help promises); the report rides stderr so it's still visible
+        # without corrupting either consumer.
+        for line in sim.event_log:
+            print(line)
+        print(report.to_json(indent=2), file=sys.stderr)
+    else:
+        print(report.to_json(indent=2))
     return 0
 
 
@@ -272,6 +396,8 @@ async def run(args) -> int:
 
     if args.plane == "trace":  # offline: reads recorder files, no cluster
         return run_trace(args)
+    if args.plane == "sim":  # offline: modeled fleet, no cluster
+        return run_sim(args)
     if not args.coordinator:
         print("--coordinator is required for this command", file=sys.stderr)
         return 2

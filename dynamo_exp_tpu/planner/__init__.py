@@ -8,5 +8,28 @@ Reference parity: ``/root/reference/examples/llm/components/planner.py``
 
 from .connector import LocalConnector, PlannerConnector
 from .planner import Planner, PlannerConfig
+from .policy import (
+    Decision,
+    PlannerObservation,
+    PlannerState,
+    ScaleAction,
+    SloTargets,
+    arm_decode_grace,
+    plan_step,
+    plan_step_slo,
+)
 
-__all__ = ["Planner", "PlannerConfig", "PlannerConnector", "LocalConnector"]
+__all__ = [
+    "Planner",
+    "PlannerConfig",
+    "PlannerConnector",
+    "LocalConnector",
+    "PlannerObservation",
+    "PlannerState",
+    "ScaleAction",
+    "Decision",
+    "SloTargets",
+    "arm_decode_grace",
+    "plan_step",
+    "plan_step_slo",
+]

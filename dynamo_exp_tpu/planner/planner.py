@@ -14,6 +14,13 @@ prefill work-queue depth), same threshold policy, same safeguards:
 - a hard chip budget caps the fleet, and fleet-changed-underneath-us
   aborts the adjustment round.
 
+The decision logic itself lives in :mod:`.policy` as the pure,
+clock-free ``plan_step`` (and the SLO-driven predictive
+``plan_step_slo``, enabled via ``PlannerConfig.slo``); this module is
+the asyncio driver that feeds it metrics and applies its actions
+through a connector. The cluster simulator (``dynamo_exp_tpu/sim/``)
+drives the very same step functions against modeled fleets.
+
 Run standalone against a live graph:
 
     python -m dynamo_exp_tpu.planner.planner \
@@ -26,16 +33,21 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
+from typing import Callable
+
+from .policy import (  # noqa: F401 - re-exported (historic home)
+    NEW_DECODE_WORKER_GRACE_PERIOD,
+    NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD,
+    PlannerObservation,
+    PlannerState,
+    SloTargets,
+    arm_decode_grace,
+    plan_step,
+    plan_step_slo,
+)
 
 logger = logging.getLogger(__name__)
-
-# Number of adjustment intervals a new decode worker is protected from
-# scale-down (reference: planner.py:42).
-NEW_DECODE_WORKER_GRACE_PERIOD = 3
-# Prefill scale-up looks this many intervals ahead along the queue's
-# observed trend (reference: planner.py:48).
-NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD = 3
 
 
 @dataclass
@@ -60,16 +72,32 @@ class PlannerConfig:
     # (reference planner.py:170 uses the same constant).
     waiting_request_kv_estimate: float = 0.02
     no_operation: bool = False  # observe only
+    # SLO-driven predictive mode: when set, decisions come from
+    # plan_step_slo (forecast KV/queue trends, size the fleet to p99
+    # TTFT/ITL targets) instead of the reactive threshold loop. The live
+    # loop feeds it the same queue/KV samples it already collects; the
+    # optional p99 measurements ride in where a caller (the simulator,
+    # or an embedder with latency histograms) provides them.
+    slo: "SloTargets | None" = None
 
 
 class Planner:
-    def __init__(self, drt, config: PlannerConfig, connector=None):
+    def __init__(
+        self,
+        drt,
+        config: PlannerConfig,
+        connector=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         from ..kv_router.metrics_aggregator import KvMetricsAggregator
         from .connector import LocalConnector
 
         self.drt = drt
         self.cfg = config
         self.connector = connector or LocalConnector(config.namespace, drt)
+        # Injected clock: the loop's interval pacing is testable (and the
+        # simulator never touches wall time).
+        self._clock = clock
         self.metrics_aggregator = KvMetricsAggregator(
             drt.namespace(config.namespace).component(config.decode_component),
             interval_s=config.metric_pulling_interval,
@@ -79,12 +107,29 @@ class Planner:
         )
         self._decode_client = None
         self._prefill_client = None
-        self.decode_worker_remaining_grace_period = 0
+        self._plan_state = PlannerState()
         # Per-interval samples.
         self.kv_load: list[float] = []
         self.prefill_queue_load: list[float] = []
+        # Optional p99 measurements for the SLO policy, set by an
+        # embedder with latency histograms before each adjustment round
+        # (cleared with the interval: absent means no signal).
+        self.ttft_p99_s: float | None = None
+        self.itl_p99_s: float | None = None
         self.adjustments: list[dict] = []  # decision log (tests/observability)
         self._stop = asyncio.Event()
+
+    @property
+    def decode_worker_remaining_grace_period(self) -> int:
+        return self._plan_state.decode_grace_remaining
+
+    @decode_worker_remaining_grace_period.setter
+    def decode_worker_remaining_grace_period(self, value: int) -> None:
+        # replace(), not a fresh PlannerState: the legacy setter must
+        # not wipe whatever other cross-interval state grows here.
+        self._plan_state = replace(
+            self._plan_state, decode_grace_remaining=value
+        )
 
     # ------------------------------------------------------------- discovery
     async def get_workers_info(self) -> tuple[list[int], list[int]]:
@@ -134,6 +179,11 @@ class Planner:
     def _reset_interval(self) -> None:
         self.kv_load = []
         self.prefill_queue_load = []
+        # p99s are per-interval measurements like the samples above: a
+        # stale breach left in place would read as pressure every round
+        # (the same scrape-outage-as-load failure observe() documents).
+        self.ttft_p99_s = None
+        self.itl_p99_s = None
 
     # ----------------------------------------------------------- adjustments
     async def make_adjustments(
@@ -148,93 +198,62 @@ class Planner:
             return
         await self.make_adjustments_with_counts(p_endpoints, d_endpoints)
 
+    def observe(
+        self, p_endpoints: list[int], d_endpoints: list[int]
+    ) -> PlannerObservation:
+        """Package the interval's samples as a pure observation. An
+        interval with no samples is NO signal, not zero load: a scrape
+        outage (likeliest exactly when workers are saturated) must never
+        read as idle and trigger a spurious scale-down. (Reference
+        relies on np.mean([]) -> nan failing every comparison; the pure
+        policy makes it explicit via Optional means.)"""
+        return PlannerObservation(
+            num_prefill=len(p_endpoints),
+            num_decode=len(d_endpoints),
+            prefill_queue=tuple(self.prefill_queue_load),
+            kv_load=tuple(self.kv_load),
+            ttft_p99_s=self.ttft_p99_s,
+            itl_p99_s=self.itl_p99_s,
+            now=self._clock(),
+        )
+
     async def make_adjustments_with_counts(
         self, p_endpoints: list[int], d_endpoints: list[int]
     ) -> None:
-        """The threshold policy itself, given the interval's fleet view
-        (public so embedders/tests can drive it without discovery)."""
+        """Thin driver over the pure policy (public so embedders/tests
+        can drive a round without discovery): build the observation,
+        take one :func:`plan_step` / :func:`plan_step_slo`, apply each
+        proposed action through the connector. The decision logic lives
+        in planner/policy.py — shared verbatim with the cluster
+        simulator."""
         cfg = self.cfg
-        curr_chips = (
-            len(p_endpoints) * cfg.prefill_engine_num_tpu
-            + len(d_endpoints) * cfg.decode_engine_num_tpu
-        )
-        # An interval with no samples is NO signal, not zero load: a
-        # scrape outage (likeliest exactly when workers are saturated)
-        # must never read as idle and trigger a spurious scale-down.
-        # (Reference relies on np.mean([]) -> nan failing every
-        # comparison; we make it explicit.)
-        avg_queue = (
-            sum(self.prefill_queue_load) / len(self.prefill_queue_load)
-            if self.prefill_queue_load
-            else None
-        )
-        avg_kv = (
-            sum(self.kv_load) / len(self.kv_load) if self.kv_load else None
-        )
-
-        # -- scale down first (reference ordering, planner.py:225-252)
-        if (
-            p_endpoints
-            and avg_queue is not None
-            and avg_queue < cfg.prefill_queue_scale_down_threshold
-            and len(p_endpoints) > cfg.min_endpoint
-        ):
-            if await self.connector.remove_component(cfg.prefill_component):
-                curr_chips -= cfg.prefill_engine_num_tpu
-                self._log_action("remove", cfg.prefill_component, avg_queue)
-        if (
-            avg_kv is not None
-            and avg_kv < cfg.decode_kv_scale_down_threshold
-            and len(d_endpoints) > cfg.min_endpoint
-        ):
-            if self.decode_worker_remaining_grace_period > 0:
-                logger.info(
-                    "decode scale-down skipped (grace period %d)",
-                    self.decode_worker_remaining_grace_period,
-                )
-            elif await self.connector.remove_component(cfg.decode_component):
-                curr_chips -= cfg.decode_engine_num_tpu
-                self._log_action("remove", cfg.decode_component, avg_kv)
-
-        # -- scale up (prefill first: its queueing also inflates decode KV)
-        if (
-            p_endpoints
-            and avg_queue is not None
-            and avg_queue > cfg.prefill_queue_scale_up_threshold
-            and curr_chips + cfg.prefill_engine_num_tpu <= cfg.max_tpu_budget
-        ):
-            trend = (
-                self.prefill_queue_load[-1] - self.prefill_queue_load[0]
-                if len(self.prefill_queue_load) >= 2
-                else 0.0
+        obs = self.observe(p_endpoints, d_endpoints)
+        if cfg.slo is not None:
+            decision, self._plan_state = plan_step_slo(
+                obs, self._plan_state, cfg, cfg.slo
             )
-            predicted = (
-                self.prefill_queue_load[-1]
-                + trend * NEW_PREFILL_WORKER_QUEUE_BUFFER_PERIOD
+        else:
+            decision, self._plan_state = plan_step(
+                obs, self._plan_state, cfg
             )
-            if predicted > cfg.prefill_queue_scale_up_threshold:
-                if await self.connector.add_component(cfg.prefill_component):
-                    curr_chips += cfg.prefill_engine_num_tpu
-                    self._log_action("add", cfg.prefill_component, avg_queue)
-            else:
-                logger.info(
-                    "prefill queue trend predicts drain (%.2f); not scaling",
-                    predicted,
-                )
-        if (
-            avg_kv is not None
-            and avg_kv > cfg.decode_kv_scale_up_threshold
-            and curr_chips + cfg.decode_engine_num_tpu <= cfg.max_tpu_budget
-        ):
-            if await self.connector.add_component(cfg.decode_component):
-                curr_chips += cfg.decode_engine_num_tpu
-                self.decode_worker_remaining_grace_period = (
-                    NEW_DECODE_WORKER_GRACE_PERIOD
-                )
-                self._log_action("add", cfg.decode_component, avg_kv)
-
-        if self.decode_worker_remaining_grace_period > 0:
-            self.decode_worker_remaining_grace_period -= 1
+        for note in decision.notes:
+            logger.info("%s", note)
+        for action in decision.actions:
+            apply = (
+                self.connector.add_component
+                if action.op == "add"
+                else self.connector.remove_component
+            )
+            if await apply(action.component):
+                self._log_action(action.op, action.component, action.signal)
+                if (
+                    decision.arm_decode_grace
+                    and action.op == "add"
+                    and action.component == cfg.decode_component
+                ):
+                    # Only a decode worker that actually spawned earns
+                    # scale-down protection.
+                    self._plan_state = arm_decode_grace(self._plan_state)
 
     def _log_action(self, op: str, component: str, signal: float) -> None:
         entry = {"op": op, "component": component, "signal": round(signal, 4)}
@@ -246,19 +265,19 @@ class Planner:
         cfg = self.cfg
         p_endpoints, d_endpoints = await self.get_workers_info()
         self._reset_interval()
-        last_adjustment = time.monotonic()
+        last_adjustment = self._clock()
         while not self._stop.is_set():
             try:
                 await self.collect_metrics()
                 if (
-                    time.monotonic() - last_adjustment
+                    self._clock() - last_adjustment
                     >= cfg.adjustment_interval
                 ):
                     if not cfg.no_operation:
                         await self.make_adjustments(p_endpoints, d_endpoints)
                     p_endpoints, d_endpoints = await self.get_workers_info()
                     self._reset_interval()
-                    last_adjustment = time.monotonic()
+                    last_adjustment = self._clock()
             except Exception:
                 # A transient control-plane error (coordinator blip,
                 # scrape failure) must not kill the scaling loop; retry

@@ -1,0 +1,711 @@
+"""The cluster simulator: modeled instances, real policy code.
+
+What is modeled and what is real (docs/simulation.md):
+
+- **Real, imported, unmodified**: edge admission
+  (:class:`~dynamo_exp_tpu.http.admission.AdmissionController` — the
+  same watermark/priority math, the same instance), decode-instance
+  selection (:class:`~dynamo_exp_tpu.kv_router.scheduler
+  .DefaultWorkerSelector` over :class:`ForwardPassMetrics`), KV-pressure
+  victim policy (:func:`~dynamo_exp_tpu.engine.scheduler
+  .select_preemption_victim`), and the planner's decision step
+  (:func:`~dynamo_exp_tpu.planner.policy.plan_step` /
+  :func:`plan_step_slo`). A policy bug visible in simulation is a bug
+  in production code, not in a reimplementation.
+- **Modeled**: time. Instances hold work for service times drawn from a
+  telemetry-fitted :class:`~.fit.ServiceTimeModel` instead of running a
+  forward pass. KV occupancy is page-counted exactly (page size, pool
+  size, per-sequence growth) but page *content* doesn't exist.
+
+Modeling approximations (documented because calibration tolerance
+depends on them): a decode round samples its per-token interval once
+(occupancy at round start, not re-priced as neighbors come and go);
+page allocation is greedy-reserving (a round grabs what it can up
+front and schedules its stall at the exhaustion instant rather than
+allocating page-by-page); preempted work re-enters as a full-context
+continuation exactly like the engine's deterministic-resume path.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..engine.scheduler import SeqState, select_preemption_victim
+from ..http.admission import (
+    AdmissionController,
+    RequestShedError,
+    ServiceOverloadedError,
+)
+from ..kv_router.protocols import ForwardPassMetrics, OverlapScores
+from ..kv_router.scheduler import (
+    DefaultWorkerSelector,
+    NoWorkersError,
+    ProcessedEndpoints,
+)
+from ..planner.planner import PlannerConfig
+from ..planner.policy import (
+    PlannerObservation,
+    PlannerState,
+    SloTargets,
+    arm_decode_grace,
+    plan_step,
+    plan_step_slo,
+)
+from .core import EventLoop
+from .fit import ServiceTimeModel
+from .report import SimReport, percentile
+from .workload import SimRequest
+
+
+def _pages(tokens: int, page_size: int) -> int:
+    return -(-tokens // page_size) if tokens > 0 else 0
+
+
+@dataclass
+class SimConfig:
+    """One simulated deployment. Instance-shape fields mirror
+    EngineConfig; edge fields mirror the HTTP AdmissionController; the
+    planner fields select and parameterize the shared decision step."""
+
+    seed: int = 0
+    # Per-instance engine shape.
+    slots_per_instance: int = 8
+    pages_per_instance: int = 256
+    page_size: int = 16
+    preempt_stall_grace_s: float = 0.5
+    max_preemptions_per_seq: int = 2
+    # Edge admission (one controller fronts the fleet).
+    max_inflight: int = 64
+    shed_watermark: int | None = None
+    # Scale the admission bound with the live fleet (max_inflight /
+    # shed_watermark are then per-instance budgets).
+    admission_per_instance: bool = False
+    # Routing.
+    queue_weight: float = 1.0
+    # Fleet.
+    initial_instances: int = 1
+    provision_s: float | None = None  # None -> service model's value
+    # Planner: None (fixed fleet) | "reactive" | "slo".
+    planner: str | None = None
+    planner_cfg: PlannerConfig | None = None
+    slo: SloTargets | None = None
+    # Service times.
+    service: ServiceTimeModel = field(default_factory=ServiceTimeModel)
+    # Bookkeeping.
+    record_events: bool = True
+    max_events: int = 50_000_000
+
+
+class _SimSeq:
+    """One in-flight request. Carries exactly the policy surface
+    :func:`select_preemption_victim` reads (state / pending_finish /
+    extract_cb / preemptions / priority / submitted_at) plus the sim's
+    own timing state — the real victim policy runs on these objects."""
+
+    __slots__ = (
+        "req", "state", "pending_finish", "extract_cb", "preemptions",
+        "priority", "submitted_at", "instance", "epoch", "pages",
+        "prompt_len", "remaining", "delivered", "round_budget",
+        "gen_round", "itl", "decode_start", "first_token_at", "stalled",
+        "stall_epoch", "cap_hit", "cached_tokens",
+    )
+
+    def __init__(self, req: SimRequest, now: float):
+        self.req = req
+        self.state = SeqState.WAITING
+        self.pending_finish = None
+        self.extract_cb = None
+        self.preemptions = 0
+        self.priority = req.priority
+        self.submitted_at = now
+        self.instance: "_SimInstance | None" = None
+        self.epoch = 0  # bumped on preemption; stale events no-op
+        self.pages = 0
+        self.prompt_len = req.prompt_len
+        self.remaining = req.max_tokens
+        self.delivered = 0
+        self.round_budget = 0
+        self.gen_round = 0
+        self.itl = 0.0
+        self.decode_start = 0.0
+        self.first_token_at = 0.0
+        self.stalled = False
+        self.stall_epoch = 0  # bumped on each hard stall; stale grace no-ops
+        self.cap_hit = False
+        self.cached_tokens = 0
+
+
+class _SimInstance:
+    __slots__ = (
+        "id", "cfg", "waiting", "bound", "stall_queue", "pages_free",
+        "metrics", "draining", "prefix_seen", "born_at",
+    )
+
+    def __init__(self, iid: int, cfg: SimConfig, now: float):
+        self.id = iid
+        self.cfg = cfg
+        self.waiting: deque[_SimSeq] = deque()
+        self.bound: list[_SimSeq] = []  # PREFILL + ACTIVE (slot holders)
+        self.stall_queue: list[_SimSeq] = []  # hard-stalled, FIFO
+        self.pages_free = cfg.pages_per_instance
+        self.draining = False
+        self.born_at = now
+        # Shared-prefix model for router overlap: group -> cached blocks.
+        self.prefix_seen: dict[int, int] = {}
+        # One mutable metrics object per instance: the router reads it
+        # in place (no per-arrival allocation at fleet scale).
+        self.metrics = ForwardPassMetrics(
+            request_total_slots=cfg.slots_per_instance,
+            kv_total_blocks=cfg.pages_per_instance,
+        )
+
+    def refresh_metrics(self) -> ForwardPassMetrics:
+        m = self.metrics
+        m.request_active_slots = len(self.bound)
+        m.num_requests_waiting = len(self.waiting)
+        used = self.cfg.pages_per_instance - self.pages_free
+        m.kv_active_blocks = used
+        m.gpu_cache_usage_perc = used / self.cfg.pages_per_instance
+        return m
+
+    @property
+    def idle(self) -> bool:
+        return not self.bound and not self.waiting
+
+
+class ClusterSim:
+    """Deterministic replay of a workload through the real policies.
+
+    One instance = one aggregated (prefill+decode) engine; the fleet
+    starts at ``initial_instances`` and moves only by planner decisions.
+    ``run()`` drains the workload and returns a :class:`SimReport`."""
+
+    def __init__(self, cfg: SimConfig, workload):
+        self.cfg = cfg
+        self.loop = EventLoop()
+        # Independent streams so adding a service-time draw never
+        # perturbs routing tie-breaks (and vice versa).
+        self.rng_service = random.Random(cfg.seed)
+        self.selector = DefaultWorkerSelector(
+            rng=random.Random(cfg.seed ^ 0x5EED), queue_weight=cfg.queue_weight
+        )
+        self.admission = AdmissionController(
+            max_inflight=cfg.max_inflight, shed_watermark=cfg.shed_watermark
+        )
+        self._base_inflight = self.admission.max_inflight
+        self._base_watermark = self.admission.shed_watermark
+        self.instances: dict[int, _SimInstance] = {}
+        self._provisioning = 0
+        self._next_iid = 0
+        self._workload = iter(workload)
+        self._last_arrival = -1.0
+        self._stream_done = False
+        self._open = 0  # admitted, not yet finished
+        self.report = SimReport()
+        self._ttfts: list[float] = []
+        self._itls: list[float] = []
+        # Per-adjustment-interval planner sample windows.
+        self._kv_samples: list[float] = []
+        self._win_ttfts: list[float] = []
+        self._win_itls: list[float] = []
+        self._plan_state = PlannerState()
+        self._pcfg = cfg.planner_cfg or PlannerConfig()
+        self._slo = cfg.slo or SloTargets()
+        self._chip_seconds = 0.0
+        self._chips_since = 0.0
+        self.event_log: list[str] = []
+        for _ in range(max(cfg.initial_instances, 1)):
+            self._spawn_ready()
+        self._resize_admission()
+
+    # ------------------------------------------------------------ logging
+    def _log(self, fmt: str, *args) -> None:
+        # %-lazy so a million-user run with record_events=False never
+        # pays per-event string formatting.
+        if self.cfg.record_events:
+            msg = fmt % args if args else fmt
+            self.event_log.append(f"{self.loop.now:.6f} {msg}")
+
+    # ------------------------------------------------------------ fleet
+    def _chips(self) -> int:
+        return len(self.instances) + self._provisioning
+
+    def _account_chips(self) -> None:
+        now = self.loop.now
+        self._chip_seconds += self._chips() * (now - self._chips_since)
+        self._chips_since = now
+
+    def _spawn_ready(self) -> _SimInstance:
+        self._account_chips()
+        iid = self._next_iid
+        self._next_iid += 1
+        inst = _SimInstance(iid, self.cfg, self.loop.now)
+        self.instances[iid] = inst
+        self.report.max_instances = max(
+            self.report.max_instances, len(self.instances)
+        )
+        self._resize_admission()
+        self._log("instance %d ready", iid)
+        return inst
+
+    def _provision(self) -> None:
+        self._account_chips()
+        self._provisioning += 1
+        delay = (
+            self.cfg.provision_s
+            if self.cfg.provision_s is not None
+            else self.cfg.service.provision_s
+        )
+        self.loop.after(delay, self._on_instance_ready)
+        self._log("instance provisioning")
+
+    def _on_instance_ready(self) -> None:
+        # Bill the provision window while the chip still counts as
+        # provisioning — decrementing first would hand every scale-up a
+        # free provision_s of chip time and bias the planner comparison.
+        self._account_chips()
+        self._provisioning -= 1
+        self._spawn_ready()
+
+    def _retire(self, inst: _SimInstance) -> None:
+        self._account_chips()
+        del self.instances[inst.id]
+        self._resize_admission()
+        self._log("instance %d retired", inst.id)
+
+    def _resize_admission(self) -> None:
+        if not self.cfg.admission_per_instance:
+            return
+        n = max(len(self.instances), 1)
+        self.admission.resize(
+            self._base_inflight * n, self._base_watermark * n
+        )
+
+    def _routable(self) -> list[_SimInstance]:
+        return [i for i in self.instances.values() if not i.draining]
+
+    # ----------------------------------------------------------- arrivals
+    def _schedule_next_arrival(self) -> None:
+        req = next(self._workload, None)
+        if req is None:
+            self._stream_done = True
+            return
+        if req.arrival_s < self._last_arrival:
+            raise ValueError("workload arrivals must be non-decreasing")
+        self._last_arrival = req.arrival_s
+        self.loop.at(req.arrival_s, self._on_arrival, req)
+
+    def _on_arrival(self, req: SimRequest) -> None:
+        self._schedule_next_arrival()
+        self.report.submitted += 1
+        try:
+            self.admission.acquire(req.priority)
+        except ServiceOverloadedError:
+            self.report.shed_503 += 1
+            self._log("req %d shed 503", req.index)
+            return
+        except RequestShedError:
+            self.report.shed_429 += 1
+            self._log("req %d shed 429", req.index)
+            return
+        candidates = self._routable()
+        endpoints = ProcessedEndpoints(
+            metrics={i.id: i.refresh_metrics() for i in candidates}
+        )
+        overlaps = OverlapScores()
+        if req.prefix_group >= 0:
+            overlaps = OverlapScores(
+                scores={
+                    i.id: i.prefix_seen.get(req.prefix_group, 0)
+                    for i in candidates
+                }
+            )
+        try:
+            wid, overlap_blocks = self.selector.select_worker(
+                endpoints,
+                overlaps,
+                req.prompt_len,
+                self.cfg.page_size,
+            )
+        except NoWorkersError:
+            self.report.errors += 1
+            self.admission.release()
+            self._log("req %d error no-workers", req.index)
+            return
+        inst = self.instances[wid]
+        seq = _SimSeq(req, self.loop.now)
+        seq.instance = inst
+        if req.prefix_group >= 0:
+            # Cache state at routing time decides this request's hit;
+            # only then does its own prefix become resident (the first
+            # request of a group is cold even on its own instance).
+            seq.cached_tokens = min(
+                inst.prefix_seen.get(req.prefix_group, 0)
+                * self.cfg.page_size,
+                req.prefix_len,
+            )
+            inst.prefix_seen[req.prefix_group] = max(
+                inst.prefix_seen.get(req.prefix_group, 0),
+                _pages(req.prefix_len, self.cfg.page_size),
+            )
+        self._open += 1
+        inst.waiting.append(seq)
+        self._log("req %d -> inst %d (overlap %d)", req.index, wid, overlap_blocks)
+        self._pump(inst)
+
+    # ---------------------------------------------------------- admission
+    def _pump(self, inst: _SimInstance) -> None:
+        """Engine-side admission: bind waiting work to free slots while
+        pages allow. Mirrors the live loop's `_kv_pressure` gate —
+        nothing is admitted while any bound row is hard-stalled, so
+        newcomers can't steal pages preemption just freed."""
+        cfg = self.cfg
+        while (
+            inst.waiting
+            and not inst.stall_queue
+            and len(inst.bound) < cfg.slots_per_instance
+        ):
+            seq = inst.waiting[0]
+            capacity_tokens = cfg.pages_per_instance * cfg.page_size
+            if seq.prompt_len > capacity_tokens:
+                # A prompt bigger than the whole pool can never be
+                # allocated — reject (finish=error) instead of waiting
+                # forever, exactly like Scheduler.admit_next.
+                inst.waiting.popleft()
+                self._finish(seq, "error")
+                continue
+            need = _pages(seq.prompt_len, cfg.page_size) - seq.pages
+            if need > inst.pages_free:
+                return  # pool exhausted; retry after a release
+            inst.waiting.popleft()
+            inst.pages_free -= max(need, 0)
+            seq.pages += max(need, 0)
+            seq.state = SeqState.PREFILL
+            inst.bound.append(seq)
+            prefill_tokens = seq.prompt_len
+            if seq.cached_tokens and seq.preemptions == 0:
+                prefill_tokens = max(seq.prompt_len - seq.cached_tokens, 1)
+            delay = cfg.service.prefill_time(
+                prefill_tokens, self.rng_service
+            )
+            self.loop.after(delay, self._on_prefill_done, seq, seq.epoch)
+
+    # ------------------------------------------------------------- decode
+    def _coverable(self, seq: _SimSeq) -> int:
+        """Tokens this round's held pages can still produce. The final
+        sampled token rides out without its KV written (engine
+        semantics), hence the +1."""
+        return seq.pages * self.cfg.page_size - seq.prompt_len + 1
+
+    def _on_prefill_done(self, seq: _SimSeq, epoch: int) -> None:
+        if seq.epoch != epoch or seq.state is not SeqState.PREFILL:
+            return
+        cfg = self.cfg
+        inst = seq.instance
+        seq.state = SeqState.ACTIVE
+        if not seq.first_token_at:
+            seq.first_token_at = self.loop.now
+            ttft = self.loop.now - seq.req.arrival_s
+            self._ttfts.append(ttft)
+            self._win_ttfts.append(ttft)
+        rows = sum(1 for s in inst.bound if s.state is SeqState.ACTIVE)
+        seq.itl = cfg.service.decode_itl(
+            rows, cfg.slots_per_instance, self.rng_service
+        )
+        seq.decode_start = self.loop.now
+        seq.gen_round = 0
+        capacity_tokens = cfg.pages_per_instance * cfg.page_size
+        max_by_cap = capacity_tokens - seq.prompt_len + 1
+        seq.round_budget = min(seq.remaining, max(max_by_cap, 0))
+        seq.cap_hit = seq.round_budget < seq.remaining
+        self._reserve_and_schedule(seq)
+
+    def _grab_round_pages(self, seq: _SimSeq) -> int:
+        """Take as many of the round's still-needed pages as are free;
+        returns the number grabbed."""
+        cfg = self.cfg
+        inst = seq.instance
+        need_total = _pages(
+            seq.prompt_len + max(seq.round_budget - 1, 0), cfg.page_size
+        )
+        grab = min(max(need_total - seq.pages, 0), inst.pages_free)
+        inst.pages_free -= grab
+        seq.pages += grab
+        return grab
+
+    def _schedule_round_progress(self, seq: _SimSeq) -> bool:
+        """Schedule the round's completion (fully covered) or its next
+        stall point; False when the held pages can't feed even the next
+        token."""
+        coverable = self._coverable(seq)
+        left = seq.round_budget - seq.gen_round
+        if coverable >= seq.round_budget:
+            self.loop.after(
+                left * seq.itl, self._on_decode_done, seq, seq.epoch
+            )
+        elif coverable > seq.gen_round:
+            self.loop.after(
+                (coverable - seq.gen_round) * seq.itl,
+                self._on_stall,
+                seq,
+                seq.epoch,
+                coverable,
+            )
+        else:
+            return False
+        return True
+
+    def _reserve_and_schedule(self, seq: _SimSeq) -> None:
+        self._grab_round_pages(seq)
+        if not self._schedule_round_progress(seq):
+            self._hard_stall(seq)
+
+    def _on_stall(self, seq: _SimSeq, epoch: int, gen_now: int) -> None:
+        if seq.epoch != epoch or seq.state is not SeqState.ACTIVE:
+            return
+        seq.gen_round = min(gen_now, seq.round_budget)
+        self._reserve_and_schedule(seq)
+
+    def _hard_stall(self, seq: _SimSeq) -> None:
+        """The row cannot feed its next token: start the preemption
+        grace clock (the engine's `stalled_since`)."""
+        if seq.stalled:
+            return
+        seq.stalled = True
+        # A resume (pages fed) then re-stall within the same epoch must
+        # get a FULL grace window (the engine re-sets stalled_since), so
+        # each stall gets its own generation and the previous stall's
+        # still-pending timer no-ops instead of firing early.
+        seq.stall_epoch += 1
+        inst = seq.instance
+        inst.stall_queue.append(seq)
+        self._log("req %d hard-stalled on inst %d", seq.req.index, inst.id)
+        grace = self.cfg.preempt_stall_grace_s
+        if grace >= 0:
+            self.loop.after(
+                grace, self._on_grace, seq, seq.epoch, seq.stall_epoch
+            )
+
+    def _on_grace(self, seq: _SimSeq, epoch: int, stall_epoch: int) -> None:
+        if (
+            seq.epoch != epoch
+            or seq.stall_epoch != stall_epoch
+            or not seq.stalled
+        ):
+            return
+        inst = seq.instance
+        victim = select_preemption_victim(
+            inst.bound, self.cfg.max_preemptions_per_seq
+        )
+        if victim is None:
+            return  # nothing eligible; stalled row waits for a release
+        self._preempt(victim)
+        self._feed_stalled(inst)
+        if seq.stalled:  # one eviction wasn't enough — keep the clock
+            self.loop.after(
+                self.cfg.preempt_stall_grace_s,
+                self._on_grace,
+                seq,
+                seq.epoch,
+                seq.stall_epoch,
+            )
+
+    def _preempt(self, victim: _SimSeq) -> None:
+        """Evict via the real victim policy and requeue the victim as a
+        deterministic continuation of itself (full context as prompt,
+        budget reduced), exactly like Scheduler.preempt."""
+        inst = victim.instance
+        gen = victim.gen_round
+        if not victim.stalled and victim.itl > 0:
+            # decode_start is the round's *virtual* start (rebased on
+            # stall-resume), so elapsed/itl = tokens actually produced.
+            gen = min(
+                max(
+                    int((self.loop.now - victim.decode_start) / victim.itl),
+                    victim.gen_round,
+                ),
+                victim.round_budget,
+            )
+        victim.epoch += 1
+        victim.delivered += gen
+        victim.prompt_len += gen
+        victim.remaining -= gen
+        victim.preemptions += 1
+        inst.pages_free += victim.pages
+        victim.pages = 0
+        inst.bound.remove(victim)
+        if victim.stalled:
+            victim.stalled = False
+            inst.stall_queue.remove(victim)
+        victim.state = SeqState.WAITING
+        inst.waiting.append(victim)  # back of the queue, like the engine
+        self.report.preemptions += 1
+        self._log(
+            "req %d preempted on inst %d (%d tokens into the round)",
+            victim.req.index, inst.id, gen,
+        )
+
+    def _feed_stalled(self, inst: _SimInstance) -> None:
+        """Freed pages go to hard-stalled rows first (admission stays
+        gated while any remain), then to engine admission."""
+        for seq in list(inst.stall_queue):
+            if self._grab_round_pages(seq) <= 0:
+                continue
+            if self._schedule_round_progress(seq):
+                seq.stalled = False
+                inst.stall_queue.remove(seq)
+                # Rebase the round's virtual start so elapsed/itl keeps
+                # equaling tokens actually produced — a preemption mid-
+                # round must not count the stall dwell as generation.
+                seq.decode_start = self.loop.now - seq.gen_round * seq.itl
+            # else: partial grab, still starved — keep queue position
+            # and the already-armed grace clock.
+        self._pump(inst)
+
+    def _on_decode_done(self, seq: _SimSeq, epoch: int) -> None:
+        if seq.epoch != epoch or seq.state is not SeqState.ACTIVE:
+            return
+        seq.delivered += seq.round_budget
+        seq.remaining -= seq.round_budget
+        self._finish(seq, "length")
+
+    # ------------------------------------------------------------- finish
+    def _finish(self, seq: _SimSeq, reason: str) -> None:
+        inst = seq.instance
+        seq.epoch += 1
+        seq.state = SeqState.FINISHED
+        if inst is not None:
+            inst.pages_free += seq.pages
+            seq.pages = 0
+            if seq in inst.bound:
+                inst.bound.remove(seq)
+            if seq.stalled:
+                seq.stalled = False
+                inst.stall_queue.remove(seq)
+        self._open -= 1
+        self.admission.release()
+        if reason == "length":
+            self.report.completed += 1
+            self.report.completed_tokens += seq.delivered
+            if seq.cap_hit:
+                self.report.capacity_capped += 1
+            if seq.delivered > 1 and seq.first_token_at:
+                itl = (self.loop.now - seq.first_token_at) / (
+                    seq.delivered - 1
+                )
+                self._itls.append(itl)
+                self._win_itls.append(itl)
+        else:
+            self.report.errors += 1
+        self._log("req %d finished %s (%d tok)", seq.req.index, reason, seq.delivered)
+        if inst is not None:
+            self._feed_stalled(inst)
+            if inst.draining and inst.idle and len(self.instances) > 1:
+                self._retire(inst)
+
+    # ------------------------------------------------------------- planner
+    def _start_planner(self) -> None:
+        if self.cfg.planner is None:
+            return
+        self.loop.after(
+            self._pcfg.metric_pulling_interval, self._on_metric_tick
+        )
+        self.loop.after(
+            self._pcfg.adjustment_interval, self._on_adjust_tick
+        )
+
+    def _fleet_busy(self) -> bool:
+        return not self._stream_done or self._open > 0
+
+    def _on_metric_tick(self) -> None:
+        """Mirror Planner.collect_metrics: one KV sample per instance
+        per scrape, biased up by waiting work about to claim cache."""
+        for inst in self.instances.values():
+            m = inst.refresh_metrics()
+            kv = m.gpu_cache_usage_perc
+            if m.request_active_slots and m.num_requests_waiting > 0:
+                kv += (
+                    self._pcfg.waiting_request_kv_estimate
+                    * m.num_requests_waiting
+                )
+            self._kv_samples.append(kv)
+        if self._fleet_busy():
+            self.loop.after(
+                self._pcfg.metric_pulling_interval, self._on_metric_tick
+            )
+
+    def _on_adjust_tick(self) -> None:
+        obs = PlannerObservation(
+            num_prefill=0,
+            num_decode=len(self.instances) + self._provisioning,
+            prefill_queue=(),
+            kv_load=tuple(self._kv_samples),
+            ttft_p99_s=percentile(self._win_ttfts, 0.99),
+            itl_p99_s=percentile(self._win_itls, 0.99),
+            now=self.loop.now,
+        )
+        if self.cfg.planner == "slo":
+            decision, self._plan_state = plan_step_slo(
+                obs, self._plan_state, self._pcfg, self._slo
+            )
+        else:
+            decision, self._plan_state = plan_step(
+                obs, self._plan_state, self._pcfg
+            )
+        for action in decision.actions:
+            entry = action.as_log() | {"t": round(self.loop.now, 3)}
+            self.report.planner_actions.append(entry)
+            self._log("planner %s (signal %.3f)", action.op, action.signal)
+            if action.op == "add":
+                self._provision()
+                if decision.arm_decode_grace:
+                    # Provisioning always lands in sim, so every
+                    # proposed add earns its grace period.
+                    self._plan_state = arm_decode_grace(self._plan_state)
+            else:
+                ready = [
+                    i for i in self.instances.values() if not i.draining
+                ]
+                if len(ready) > self._pcfg.min_endpoint:
+                    inst = max(ready, key=lambda i: i.id)  # youngest
+                    inst.draining = True
+                    if inst.idle and len(self.instances) > 1:
+                        self._retire(inst)
+        self._kv_samples = []
+        self._win_ttfts = []
+        self._win_itls = []
+        if self._fleet_busy():
+            self.loop.after(
+                self._pcfg.adjustment_interval, self._on_adjust_tick
+            )
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> SimReport:
+        t0 = time.perf_counter()
+        self._chips_since = self.loop.now
+        self._schedule_next_arrival()
+        self._start_planner()
+        self.loop.run(max_events=self.cfg.max_events)
+        self._account_chips()
+        r = self.report
+        if self._open > 0:
+            # Requests stranded with no event left to free them (every
+            # row stalled at its preemption bound): the live analogue is
+            # a hang, which the engine's capacity fixes make unreachable
+            # in practice — surface it as errors, never silently.
+            self._log("%d requests starved at drain", self._open)
+            r.errors += self._open
+        r.duration_s = self.loop.now
+        r.events = self.loop.processed
+        r.wall_clock_s = round(time.perf_counter() - t0, 3)
+        r.chip_seconds = round(self._chip_seconds, 3)
+        if r.duration_s > 0:
+            r.goodput_tok_s = round(r.completed_tokens / r.duration_s, 3)
+        r.ttft_p50_s = percentile(self._ttfts, 0.5)
+        r.ttft_p99_s = percentile(self._ttfts, 0.99)
+        r.itl_p50_s = percentile(self._itls, 0.5)
+        r.itl_p99_s = percentile(self._itls, 0.99)
+        return r

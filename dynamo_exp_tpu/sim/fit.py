@@ -1,0 +1,240 @@
+"""Service-time models fitted from real telemetry.
+
+The simulator's instances don't compute anything — they hold a request
+for as long as the real engine would have. Those holds come from a
+:class:`ServiceTimeModel`, fitted from whichever telemetry the repo has
+actually produced:
+
+- **span JSONL** (the telemetry recorder, ``DYN_TRACE_FILE`` /
+  ``llmctl trace``): ``prefill`` spans carry ``prompt_tokens`` and a
+  duration → per-prompt-token prefill time; ``decode`` spans carry
+  ``generated_tokens`` → inter-token latency (ITL).
+- **BENCH JSON** (``bench.py`` output, or the driver's ``BENCH_r*.json``
+  wrappers with a ``parsed`` record): ``decode_throughput_*_c{N}``
+  lines give aggregate tok/s at concurrency N → per-row ITL = N/tok_s;
+  ``p50_ttft_s`` over the metric's ISL gives prefill per token.
+
+Latencies are modeled lognormal (service times are multiplicative:
+right-skewed, never negative) around the fitted median; draws come from
+the simulation's seeded ``random.Random`` so runs stay deterministic.
+When no telemetry is available, :meth:`ServiceTimeModel.default` gives
+round numbers in the right ratios (prefill ~10x cheaper per token than
+decode per-token, both ms-scale) — calibration tests use exact-count
+invariants, not absolute latencies, so defaults are fine there.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable
+
+
+@dataclass
+class LatencyDist:
+    """Lognormal latency around a median: ``median * exp(sigma * z)``.
+    ``sigma=0`` degenerates to a constant — the calibration suites use
+    that for exactly reproducible timings."""
+
+    median_s: float
+    sigma: float = 0.0
+
+    def sample(self, rng) -> float:
+        if self.sigma <= 0.0:
+            return self.median_s
+        return self.median_s * math.exp(self.sigma * rng.gauss(0.0, 1.0))
+
+    @classmethod
+    def fit(cls, samples: Iterable[float]) -> "LatencyDist":
+        logs = [math.log(s) for s in samples if s > 0.0]
+        if not logs:
+            raise ValueError("no positive samples to fit")
+        mu = sum(logs) / len(logs)
+        var = sum((x - mu) ** 2 for x in logs) / len(logs)
+        return cls(median_s=math.exp(mu), sigma=math.sqrt(var))
+
+
+@dataclass
+class ServiceTimeModel:
+    """How long a modeled instance holds work.
+
+    ``batch_congestion`` captures the occupancy cost the engine's
+    row-compacted decode actually shows (docs/engine_perf.md): per-row
+    ITL at full occupancy is ``(1 + batch_congestion)`` times the
+    single-row ITL, interpolated linearly in between. TPU decode is
+    HBM-bound and row-compaction keeps cost ∝ occupancy, so the slope
+    is mild — but it is what makes "more load on one instance" cost
+    something, which routing and scaling policies need to see."""
+
+    prefill_token_s: LatencyDist = field(
+        default_factory=lambda: LatencyDist(0.002)
+    )
+    prefill_floor_s: float = 0.01  # dispatch floor for tiny prompts
+    itl_s: LatencyDist = field(default_factory=lambda: LatencyDist(0.02))
+    batch_congestion: float = 0.25
+    provision_s: float = 2.0  # worker add → serving (planner sees this)
+
+    def prefill_time(self, prompt_tokens: int, rng) -> float:
+        return self.prefill_floor_s + prompt_tokens * self.prefill_token_s.sample(
+            rng
+        )
+
+    def decode_itl(self, rows: int, slots: int, rng) -> float:
+        """Per-token interval for one row when ``rows`` of ``slots``
+        slots are occupied (sampled once per decode round per row)."""
+        base = self.itl_s.sample(rng)
+        if slots <= 1:
+            return base
+        fill = (max(rows, 1) - 1) / max(slots - 1, 1)
+        return base * (1.0 + self.batch_congestion * fill)
+
+    def planner_hints(self) -> dict:
+        """Fitted per-worker service rates the SLO planner can budget
+        with (tokens/s at median latency, congestion-free)."""
+        return {
+            "decode_tokens_per_s": 1.0 / max(self.itl_s.median_s, 1e-9),
+            "prefill_tokens_per_s": 1.0
+            / max(self.prefill_token_s.median_s, 1e-9),
+            "provision_s": self.provision_s,
+        }
+
+    # ------------------------------------------------------------ fitting
+    @classmethod
+    def default(cls) -> "ServiceTimeModel":
+        return cls()
+
+    @classmethod
+    def from_spans(cls, paths: Iterable[str | Path]) -> "ServiceTimeModel":
+        """Fit from telemetry recorder JSONL (span events)."""
+        prefill_per_token, itl = _span_samples(paths)
+        model = cls.default()
+        if prefill_per_token:
+            model.prefill_token_s = LatencyDist.fit(prefill_per_token)
+        if itl:
+            model.itl_s = LatencyDist.fit(itl)
+        return model
+
+    @classmethod
+    def from_bench_json(
+        cls, paths: Iterable[str | Path]
+    ) -> "ServiceTimeModel":
+        """Fit from ``bench.py`` JSON lines, or the driver's
+        ``BENCH_r*.json`` wrapper (a dict with a ``parsed`` record)."""
+        prefill_per_token, itl = _bench_samples(paths)
+        model = cls.default()
+        if itl:
+            model.itl_s = LatencyDist.fit(itl)
+        if prefill_per_token:
+            model.prefill_token_s = LatencyDist.fit(prefill_per_token)
+        return model
+
+    @classmethod
+    def from_telemetry(
+        cls,
+        span_paths: Iterable[str | Path] = (),
+        bench_paths: Iterable[str | Path] = (),
+    ) -> "ServiceTimeModel":
+        """Spans win where both sources speak (they are per-request
+        measurements; bench numbers are aggregates)."""
+        bench_p, bench_i = (
+            _bench_samples(bench_paths) if bench_paths else ([], [])
+        )
+        span_p, span_i = _span_samples(span_paths) if span_paths else ([], [])
+        model = cls.default()
+        prefill = span_p or bench_p
+        itl = span_i or bench_i
+        if prefill:
+            model.prefill_token_s = LatencyDist.fit(prefill)
+        if itl:
+            model.itl_s = LatencyDist.fit(itl)
+        return model
+
+
+def _span_samples(
+    paths: Iterable[str | Path],
+) -> tuple[list[float], list[float]]:
+    prefill_per_token: list[float] = []
+    itl: list[float] = []
+    for path in paths:
+        for line in Path(path).read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if ev.get("type") != "span":
+                continue
+            dur = float(ev.get("end", 0.0)) - float(ev.get("start", 0.0))
+            attrs = ev.get("attrs") or {}
+            if ev.get("stage") == "prefill" and dur > 0:
+                toks = int(attrs.get("prompt_tokens") or 0) - int(
+                    attrs.get("cached_tokens") or 0
+                )
+                if toks > 0:
+                    prefill_per_token.append(dur / toks)
+            elif ev.get("stage") == "decode" and dur > 0:
+                # The span runs first-token -> finish and
+                # generated_tokens counts the first token, so the
+                # duration covers toks-1 inter-token intervals (same
+                # convention as the sim's own ITL report).
+                toks = int(attrs.get("generated_tokens") or 0)
+                if toks > 1:
+                    itl.append(dur / (toks - 1))
+    return prefill_per_token, itl
+
+
+def _bench_samples(
+    paths: Iterable[str | Path],
+) -> tuple[list[float], list[float]]:
+    itl: list[float] = []
+    prefill_per_token: list[float] = []
+    for path in paths:
+        text = Path(path).read_text().strip()
+        records: list[dict] = []
+        try:
+            doc = json.loads(text)
+            if isinstance(doc, dict):
+                parsed = doc.get("parsed")
+                if isinstance(parsed, dict):
+                    records.append(parsed)
+                elif "metric" in doc:
+                    records.append(doc)
+            elif isinstance(doc, list):
+                records.extend(d for d in doc if isinstance(d, dict))
+        except json.JSONDecodeError:
+            for line in text.splitlines():
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(d, dict):
+                    records.append(d)
+        for rec in records:
+            metric = str(rec.get("metric", ""))
+            value = rec.get("value")
+            if not metric or not isinstance(value, (int, float)):
+                continue
+            if value <= 0:
+                continue
+            m = re.search(r"_c(\d+)$", metric) or re.search(
+                r"_a(\d+)of\d+$", metric
+            )
+            conc = int(m.group(1)) if m else None
+            if metric.startswith(
+                ("decode_throughput", "decode_occupancy")
+            ) and conc:
+                itl.append(conc / float(value))
+            ttft = rec.get("p50_ttft_s")
+            isl_m = re.search(r"_isl(\d+)", metric)
+            if (
+                isinstance(ttft, (int, float))
+                and ttft > 0
+                and isl_m is not None
+            ):
+                prefill_per_token.append(float(ttft) / int(isl_m.group(1)))
+    return prefill_per_token, itl

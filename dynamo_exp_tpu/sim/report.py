@@ -1,0 +1,73 @@
+"""Simulation outcome report: the numbers policies are judged on."""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+
+
+def percentile(samples: list[float], q: float) -> float | None:
+    """Nearest-rank percentile: ``sorted[ceil(q*n) - 1]``. On a 2-sample
+    window p99 is the MAX, not the min — these window percentiles feed
+    the SLO planner's pressure terms, and flooring the rank would hide
+    a breached tail exactly in low-throughput windows. None on no
+    samples."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    rank = min(max(math.ceil(q * len(s)), 1), len(s))
+    return s[rank - 1]
+
+
+@dataclass
+class SimReport:
+    """Aggregate outcome of one simulated run.
+
+    ``goodput_tok_s`` counts only tokens of *completed* requests over
+    the active window — shed or errored work contributes nothing, so a
+    policy that admits everything and thrashes scores worse than one
+    that sheds cleanly. ``chip_seconds`` integrates fleet size over sim
+    time: the planner comparison holds it (approximately) equal so the
+    goodput delta is attributable to the policy, not to spend."""
+
+    duration_s: float = 0.0
+    submitted: int = 0
+    completed: int = 0
+    shed_429: int = 0
+    shed_503: int = 0
+    errors: int = 0
+    preemptions: int = 0
+    # Requests whose prompt+max_tokens exceeded one instance's whole KV
+    # pool and finished `length` at the capacity cap (live-engine
+    # semantics) — counted in `completed`, but with tokens undelivered,
+    # so a nonzero value flags goodput that looks better than it is.
+    capacity_capped: int = 0
+    completed_tokens: int = 0
+    goodput_tok_s: float = 0.0
+    ttft_p50_s: float | None = None
+    ttft_p99_s: float | None = None
+    itl_p50_s: float | None = None
+    itl_p99_s: float | None = None
+    max_instances: int = 0
+    chip_seconds: float = 0.0
+    events: int = 0
+    wall_clock_s: float = 0.0
+    planner_actions: list[dict] = field(default_factory=list)
+
+    @property
+    def shed(self) -> int:
+        return self.shed_429 + self.shed_503
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / self.submitted if self.submitted else 0.0
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items()}
+        d["shed"] = self.shed
+        d["shed_rate"] = round(self.shed_rate, 4)
+        return d
+
+    def to_json(self, indent: int | None = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent, default=str)

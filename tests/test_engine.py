@@ -92,6 +92,8 @@ async def test_greedy_decode_matches_oracle(engine):
     assert final["completion_tokens"] == 8
 
 
+@pytest.mark.slow  # 6 concurrent streams + oracle replays: minutes of
+# row-bucket compiles on a small CPU box; still in make test/nightly.
 async def test_concurrent_requests_batch(engine):
     async def one(seed):
         prompt = list(np.random.RandomState(seed).randint(3, 200, size=12))

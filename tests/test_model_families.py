@@ -214,6 +214,8 @@ def _hf_env(monkeypatch):
     torch.manual_seed(0)  # deterministic random init → stable tolerances
 
 
+@pytest.mark.slow  # full-logit torch parity: the longest single model
+# proof; the per-family engine serve tests keep covering qwen2 in tier-1.
 def test_hf_parity_qwen2(tmp_path, _hf_env):
     transformers = pytest.importorskip("transformers")
     c = transformers.Qwen2Config(

@@ -68,6 +68,18 @@ async def test_decode_scale_up_on_high_kv_load():
     )
 
 
+async def test_failed_decode_add_does_not_arm_grace():
+    """Grace protects a NEW worker from scale-down; an add the
+    connector rejected spawned nothing, so the next low-load interval
+    may scale down immediately."""
+    conn = FakeConnector(fail=True)
+    p = make_planner(conn)
+    p.kv_load = [0.95, 0.97]
+    await p.make_adjustments_with_counts([], [1])
+    assert ("add", p.cfg.decode_component) in conn.calls  # attempted
+    assert p.decode_worker_remaining_grace_period == 0  # not armed
+
+
 async def test_decode_scale_down_blocked_by_grace_period_then_allowed():
     conn = FakeConnector()
     p = make_planner(conn)
