@@ -17,6 +17,11 @@ OVERLOAD_SEED_SETS := 7,21,1337 3,9,27
 # regression runs (determinism, calibration vs the live overload
 # harness, reactive-vs-SLO planner comparison) in tests/test_sim.py.
 SIM_SEED_SETS := 7,21,1337 3,9,27
+# Speculative-decoding seed set: re-run the resumable (mid-stream
+# failover) and overload (preempt→resume) identity suites with
+# speculation force-enabled via the DYN_SPEC env toggle — every stream
+# must stay token-identical with spec on (docs/speculative.md).
+SPEC_SEED_SETS := 7,21,1337
 
 .PHONY: test pre-merge nightly chaos sim sim-scale lint
 
@@ -45,6 +50,10 @@ chaos:
 	for seeds in $(OVERLOAD_SEED_SETS); do \
 		echo "=== overload suite, CHAOS_SEEDS=$$seeds ==="; \
 		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_overload.py -q -m chaos; \
+	done; \
+	for seeds in $(SPEC_SEED_SETS); do \
+		echo "=== spec-on identity suites (DYN_SPEC=ngram), CHAOS_SEEDS=$$seeds ==="; \
+		env DYN_SPEC=ngram CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_resumable.py tests/test_overload.py -q -m "not slow"; \
 	done
 
 # Seeded simulator regression sets (mirrors `make chaos`): every seed

@@ -57,6 +57,9 @@ CPU_SWEEP_CONCURRENCY = (1, 2, 4)
 CPU_SWEEP_KW = dict(slots=4, isl=128, osl=32)  # occupancy/overload sweeps
 CPU_OVERLOAD_BURSTS = (4, 8, 16)
 CPU_PREFIX_KW = dict(isl=256, osl=8, concurrency=4)
+# Spec-sweep CPU fallback: same trimming policy as every other sweep —
+# tiny shapes, one draft length besides the off baseline.
+CPU_SPEC_KW = dict(slots=2, isl=96, osl=32, draft_lens=(0, 4))
 
 # Burst policy: warmup rounds (compile + program load) and timed rounds
 # (best-of). The CPU fallback trims both to 1 — XLA:CPU timings are
@@ -404,6 +407,163 @@ def run_overload_sweep(
     return out
 
 
+def run_spec_sweep(
+    slots: int = 4,
+    isl: int = 512,
+    osl: int = 128,
+    draft_lens: tuple[int, ...] = (0, 2, 4, 8),
+) -> list[dict]:
+    """Speculative decoding: tok/s + acceptance across draft lengths
+    and workload repetitiveness (docs/speculative.md).
+
+    Two workloads bound the drafter's operating range: ``repeat``
+    prompts tile one random block (prefix-repetitive — the prompt-
+    lookup n-gram match should hit, acceptance and tokens-per-dispatch
+    should rise above 1), ``random`` prompts have no repeated structure
+    (lookup mostly misses and the adaptive controller's miss backoff
+    should keep the overhead near zero). ``draft_lens`` sweeps the
+    pinned per-row draft length; 0 is the speculation-off baseline.
+    Every JSON line carries the draft config and the measured
+    acceptance, so the sim's service-time fit can learn
+    tokens-per-dispatch from these lines."""
+    import asyncio
+
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    _enable_compile_cache()
+    mcfg = _preset(MODEL)
+    rs = np.random.RandomState(0)
+
+    def engine_cfg(n_slots: int, spec_mode: str, draft: int) -> "EngineConfig":
+        return EngineConfig(
+            model=mcfg,
+            max_decode_slots=n_slots,
+            page_size=16,
+            num_pages=n_slots * ((isl + osl) // 16 + 2) + 64,
+            max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
+            eos_token_ids=[],
+            kv_dtype=_kv_dtype(),
+            decode_window=8,
+            spec_mode=spec_mode,
+            spec_draft_len=max(draft, 1),
+            spec_max_draft=max(draft, 1),
+            # Pin the draft length: this sweep measures the length axis
+            # itself, not the controller's trajectory.
+            spec_adaptive=False,
+        )
+
+    def probe_block(n: int) -> list[int]:
+        """The model's own greedy tail over a random prompt: a genuinely
+        prefix-repetitive workload must repeat content the model
+        actually continues (an arbitrary random block tiled into a
+        prompt is repetitive to the *drafter* but not to the target's
+        greedy trajectory, so acceptance would measure luck)."""
+        eng = TPUEngine(engine_cfg(1, "off", 0), seed=0)
+        eng.start()
+
+        async def gen(prompt):
+            b = BackendInput(token_ids=prompt)
+            b.stop_conditions.max_tokens = osl
+            b.stop_conditions.ignore_eos = True
+            stream = await eng.generate(b.to_dict())
+            toks = []
+            async for item in stream:
+                toks.extend(item.get("token_ids", []))
+            return toks
+
+        tail = asyncio.run(
+            gen(rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist())
+        )[-n:]
+        eng.stop()
+        return [int(t) for t in tail]
+
+    def build_prompts(workload: str) -> list[list[int]]:
+        if workload == "repeat":
+            block = probe_block(16)
+            return [block * (isl // 16) for _ in range(slots)]
+        return [
+            rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
+            for _ in range(slots)
+        ]
+
+    out = []
+    for workload in ("repeat", "random"):
+        # One fixed prompt set per workload: the sweep's axis is the
+        # draft length, so every draft point (incl. the d0 baseline)
+        # must serve the SAME prompts or the deltas mix in prompt
+        # variation.
+        workload_prompts = build_prompts(workload)
+        for draft in draft_lens:
+            cfg = engine_cfg(slots, "off" if draft == 0 else "ngram", draft)
+            engine = TPUEngine(cfg, seed=0)
+            engine.start()
+
+            async def run_one(prompt):
+                b = BackendInput(token_ids=prompt)
+                b.stop_conditions.max_tokens = osl
+                b.stop_conditions.ignore_eos = True
+                stream = await engine.generate(b.to_dict())
+                n = 0
+                async for item in stream:
+                    n += len(item.get("token_ids", []))
+                return n
+
+            async def burst(batch):
+                for _ in range(WARMUP_BURSTS):
+                    await asyncio.gather(*[run_one(p) for p in batch])
+                best = 0.0
+                for _ in range(TIMED_BURSTS):
+                    t0 = time.perf_counter()
+                    results = await asyncio.gather(
+                        *[run_one(p) for p in batch]
+                    )
+                    best = max(
+                        best, sum(results) / (time.perf_counter() - t0)
+                    )
+                return best
+
+            tok_s = asyncio.run(burst(workload_prompts))
+            m = engine.metrics()
+            drafted = m["spec_draft_tokens"]
+            # Per-ROW basis: a batched verify dispatch over N rows is N
+            # row participations; emitted / device-dispatches would
+            # conflate batch occupancy with speculation speedup (the
+            # sim fit divides per-row ITL by this number).
+            dispatches = m["spec_row_dispatches"]
+            out.append(
+                {
+                    "metric": f"spec_decode_{MODEL}_isl{isl}_osl{osl}"
+                    f"_{workload}_d{draft}",
+                    "value": round(tok_s, 1),
+                    "unit": "tok/s",
+                    "vs_baseline": round(
+                        tok_s / _roofline_tok_s(engine.params, slots), 4
+                    ),
+                    "workload": workload,
+                    "spec": {
+                        "mode": cfg.spec_mode,
+                        "draft_len": draft,
+                        "ngram": cfg.spec_ngram,
+                    },
+                    "draft_tokens": drafted,
+                    "accepted_tokens": m["spec_accepted_tokens"],
+                    "acceptance_rate": round(
+                        m["spec_accepted_tokens"] / drafted, 4
+                    )
+                    if drafted
+                    else None,
+                    "tokens_per_dispatch": round(
+                        m["spec_emitted_tokens"] / dispatches, 4
+                    )
+                    if dispatches
+                    else None,
+                }
+            )
+            engine.stop()
+    return out
+
+
 def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> dict:
     """TTFT with a warm shared prefix vs cold prompts.
 
@@ -570,6 +730,12 @@ def main() -> None:
         "degradation curve)",
     )
     ap.add_argument(
+        "--spec-sweep",
+        action="store_true",
+        help="speculative decoding tok/s + acceptance across draft "
+        "lengths {0,2,4,8} on prefix-repetitive vs random workloads",
+    )
+    ap.add_argument(
         "--model",
         default=None,
         help=f"preset name (default {MODEL}; {CPU_MODEL} on CPU fallback)",
@@ -612,6 +778,9 @@ def main() -> None:
             dict(CPU_SWEEP_KW, burst_levels=CPU_OVERLOAD_BURSTS) if cpu else {}
         )
         for point in run_overload_sweep(**kw):
+            emit(point)
+    elif args.spec_sweep:
+        for point in run_spec_sweep(**(CPU_SPEC_KW if cpu else {})):
             emit(point)
     elif args.prefix_reuse:
         emit(run_prefix_reuse(**(CPU_PREFIX_KW if cpu else {})))

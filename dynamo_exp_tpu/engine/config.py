@@ -7,6 +7,7 @@ configure our own continuous-batching TPU engine.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 from ..models.config import ModelConfig
@@ -87,6 +88,27 @@ class EngineConfig:
     # Per-request preemption bound: a sequence preempted this many times
     # is exempt from further victimization (no re-prefill live-lock).
     max_preemptions_per_seq: int = 2
+    # Speculative decoding (docs/speculative.md): "off", or a drafter
+    # name from the spec/ registry ("ngram" = prompt-lookup, no second
+    # model). The DYN_SPEC env var overrides "off" (the chaos identity
+    # suites run with DYN_SPEC=ngram to prove failover/preemption stay
+    # token-identical with speculation on).
+    spec_mode: str = "off"
+    # Initial per-row draft length; the adaptive controller moves it
+    # within [spec_min_draft, spec_max_draft] from the rolling
+    # acceptance rate (spec_adaptive=False pins it — bench sweeps).
+    spec_draft_len: int = 4
+    spec_min_draft: int = 1
+    spec_max_draft: int = 8
+    spec_adaptive: bool = True
+    # Prompt-lookup drafter: trailing n-gram widths tried (longest
+    # first) against the row's own prompt+generated context.
+    spec_ngram: int = 3
+    spec_ngram_min: int = 1
+    # Miss backoff: after this many consecutive empty proposals the row
+    # stops being probed until its context grows by spec_retry_tokens.
+    spec_miss_limit: int = 4
+    spec_retry_tokens: int = 32
     # Disaggregation KV-handoff lease TTL: extracted prompt pages stay
     # pinned in HBM this long awaiting the decode worker's delivery ack;
     # the engine-loop reaper reclaims orphans (decode instance died
@@ -100,6 +122,26 @@ class EngineConfig:
         self.prefill_buckets = sorted(set(self.prefill_buckets))
         if self.kv_dtype not in ("bfloat16", "float32"):
             raise ValueError(f"unsupported kv_dtype: {self.kv_dtype!r}")
+        env = os.environ.get("DYN_SPEC", "").strip()
+        if env and self.spec_mode == "off":
+            # Env toggle for whole suites (`make chaos` SPEC_SEED_SETS):
+            # flips speculation on for every engine the process builds
+            # without touching call sites; an explicit spec_mode wins.
+            # Falsy spellings stay off — DYN_SPEC=0 after a chaos run
+            # must not be parsed as a drafter name and crash startup.
+            low = env.lower()
+            if low in ("1", "true", "on"):
+                self.spec_mode = "ngram"
+            elif low not in ("0", "false", "no", "off"):
+                self.spec_mode = env
+        if self.spec_max_draft < self.spec_min_draft or self.spec_min_draft < 1:
+            raise ValueError(
+                f"bad spec draft bounds [{self.spec_min_draft}, "
+                f"{self.spec_max_draft}]"
+            )
+        self.spec_draft_len = min(
+            max(self.spec_draft_len, self.spec_min_draft), self.spec_max_draft
+        )
 
     @property
     def kv_dtype_jnp(self):
@@ -146,6 +188,13 @@ class EngineConfig:
         FLOPs and HBM traffic track true occupancy, not the slot
         envelope."""
         return self._pow2_bucket(n, 1, self.max_decode_slots)
+
+    def spec_draft_bucket_for(self, n: int) -> int:
+        """Static draft-slot bucket for the speculative verify dispatch
+        (2/4/8/... capped at spec_max_draft): one compiled verify
+        variant per bucket, same O(log) discipline as every other
+        static-shape family."""
+        return self._pow2_bucket(n, 2, max(self.spec_max_draft, 2))
 
     def page_move_bucket_for(self, n: int) -> int:
         """Static page-count bucket for batched KV page gather/scatter

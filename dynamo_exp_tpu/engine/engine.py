@@ -68,6 +68,8 @@ from ..models.llama import (
 from ..ops.sampling import (
     apply_penalties,
     sample_tokens_seeded,
+    spec_accept_length,
+    spec_verify_tokens,
     stop_token_hit,
     token_logprobs,
 )
@@ -121,6 +123,21 @@ class _PendingPrefill:
 
     ys: tuple
     completed: list  # [(row, Sequence)] rows whose prompt finished
+    want_lp: bool
+
+
+@dataclass
+class _PendingSpec:
+    """One dispatched speculative verify pass (docs/speculative.md).
+
+    Always consumed in the same loop iteration it was dispatched —
+    speculation re-plans drafts from the freshly accepted tokens every
+    round, so there is nothing to chain (spec rows break the device-to-
+    device decode chain exactly like capacity-capped rows do)."""
+
+    ys: tuple  # targets [rows, T], n_emit [rows] (+ lp arrays when want_lp)
+    stepped: list  # [(Sequence, n_drafts, row)]
+    full_sampler: bool
     want_lp: bool
 
 
@@ -231,6 +248,16 @@ class TPUEngine(AsyncEngine):
         # and want_lp); prefill by (row bucket, token bucket, page bound).
         self._decode_fns: dict[tuple, Callable] = {}
         self._prefill_fns: dict[tuple[int, int, int], Callable] = {}
+        # Speculative verify variants, keyed by (row bucket, draft
+        # bucket, page bound, full-vs-greedy sampler, want_lp).
+        self._spec_fns: dict[tuple, Callable] = {}
+        # Host-side speculation state (drafter + per-row adaptive
+        # controller); None = speculation off.
+        self._spec = None
+        if cfg.spec_mode != "off":
+            from ..spec import SpecManager
+
+            self._spec = SpecManager(cfg)
         # Fresh penalty row for a slot: zero it, then count the first
         # sampled token so penalties see every generated token.
         self._init_row = jax.jit(
@@ -253,6 +280,16 @@ class TPUEngine(AsyncEngine):
         self.kv_page_moves = 0  # pages moved by batched gather/scatter
         self.kv_move_dispatches = 0  # batched-move dispatches issued
         self.preempted = 0  # sequences preempted under KV pressure
+        # Speculative decoding counters (docs/speculative.md): proposed
+        # draft tokens, the prefix the verify pass accepted, tokens
+        # actually emitted, and verify dispatches issued — acceptance
+        # rate and tokens-per-dispatch derive from these (mirrored to
+        # /metrics and bench.py --spec-sweep).
+        self.spec_dispatches = 0  # batched verify dispatches (device)
+        self.spec_row_dispatches = 0  # row participations (per-row basis)
+        self.spec_draft_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_emitted_tokens = 0
         # KV handoff leases: confirmations arrive from asyncio threads
         # (the prefill worker's delivery ack) but the page manager is
         # single-writer — queue them for the loop thread, which also
@@ -507,6 +544,94 @@ class TPUEngine(AsyncEngine):
 
         self._prefill_fns[key] = prefill_step
         return prefill_step
+
+    def _spec_fn(
+        self,
+        rows: int,
+        k_bucket: int,
+        attn_pages: int,
+        full_sampler: bool,
+        want_lp: bool,
+    ):
+        """One compiled speculative *verify* pass (docs/speculative.md):
+        the row's last confirmed token plus up to ``k_bucket`` draft
+        tokens ride through the target model as a T = k_bucket + 1 wide
+        chunked-prefill-shaped dispatch (always the XLA paged path —
+        ``forward`` only takes the Pallas decode kernel at T == 1), and
+        the target's counter-keyed token at every absolute position
+        comes back in the same dispatch.
+
+        Because each draw is keyed by (seed, fed position) — the same
+        key the step-by-step decode window would use — the accepted
+        prefix plus the first correction token is *exactly* the token
+        sequence the non-speculative engine would have emitted. The
+        greedy variant is a plain per-position argmax; the full-sampler
+        variant threads penalty counts through a scan with rejected
+        positions masked out of the counts (ops/sampling.
+        spec_verify_tokens), so the penalty state rewinds with the KV.
+
+        KV for positions past the accepted prefix is teacher-forced
+        garbage, but attention masks strictly by query position and the
+        host rewinds ``wpos`` to the accepted length, so the next
+        dispatch overwrites the first garbage slot and never attends
+        past its own position — no garbage KV survives."""
+        key = (rows, k_bucket, attn_pages, full_sampler, want_lp)
+        fn = self._spec_fns.get(key)
+        if fn is not None:
+            return fn
+        mcfg = self.cfg.model
+        pages = attn_pages
+
+        def pack_ys(logits, targets, n_emit):
+            if not want_lp:
+                return (targets, n_emit)
+            V = logits.shape[-1]
+            lp, tid, tlp = token_logprobs(
+                logits.reshape(-1, V), targets.reshape(-1)
+            )
+            B, T = targets.shape
+            return (
+                targets,
+                n_emit,
+                lp.reshape(B, T),
+                tid.reshape(B, T, -1),
+                tlp.reshape(B, T, -1),
+            )
+
+        if full_sampler:
+
+            @partial(jax.jit, donate_argnums=(1, 2, 7))
+            def spec_verify(params, k, v, tokens, positions, page_table,
+                            n_drafts, counts_all, slot_map, seeds, temp,
+                            top_k, top_p, freq_pen, pres_pen, rep_pen):
+                logits, k, v = forward(
+                    params, mcfg, tokens, positions, page_table, k, v,
+                    attn_pages=pages,
+                )
+                counts0 = counts_all[slot_map]
+                targets, n_emit, counts = spec_verify_tokens(
+                    logits, tokens[:, 1:], n_drafts, seeds, positions,
+                    temp, top_k, top_p, counts0, freq_pen, pres_pen,
+                    rep_pen,
+                )
+                counts_all = counts_all.at[slot_map].set(counts)
+                return pack_ys(logits, targets, n_emit), k, v, counts_all
+
+        else:
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def spec_verify(params, k, v, tokens, positions, page_table,
+                            n_drafts):
+                logits, k, v = forward(
+                    params, mcfg, tokens, positions, page_table, k, v,
+                    attn_pages=pages,
+                )
+                targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                n_emit = spec_accept_length(targets, tokens[:, 1:], n_drafts)
+                return pack_ys(logits, targets, n_emit), k, v
+
+        self._spec_fns[key] = spec_verify
+        return spec_verify
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -772,10 +897,15 @@ class TPUEngine(AsyncEngine):
                 # Decode dispatches BEFORE the prefill sync: the window
                 # executes behind the prefill on the device stream while
                 # the host consumes prefill completions.
-                pendings = self._dispatch_decode()
-                progressed = progressed or bool(pendings)
+                pendings, spec_pendings = self._dispatch_decode()
+                progressed = progressed or bool(pendings) or bool(spec_pendings)
                 if pending_prefill is not None:
                     self._consume_prefill(pending_prefill)
+                # Verify passes consume in the same iteration: the next
+                # round's drafts are proposed from the tokens they just
+                # confirmed, so there is nothing to overlap.
+                for sp in spec_pendings:
+                    self._consume_spec(sp)
                 if (
                     len(pendings) == 1
                     and pendings[0].solo
@@ -1247,14 +1377,19 @@ class TPUEngine(AsyncEngine):
         stops = list(self.cfg.eos_token_ids) + list(sc.stop_token_ids)
         return stops[: self.cfg.device_stop_width]
 
-    def _dispatch_decode(self) -> list[_PendingDecode]:
+    def _dispatch_decode(
+        self,
+    ) -> tuple[list[_PendingDecode], list[_PendingSpec]]:
         """Dispatch this iteration's decode window(s) over the ACTIVE
         slots: rows are compacted (no dead slots) and partitioned into a
         greedy window and a full-sampler window, each compiled at its
         own row bucket — so decode cost tracks occupancy and a lone
         creative request doesn't drag greedy rows through the sampler.
-        Returns the pending (unsynced) dispatches; [] when nothing could
-        step (no ACTIVE rows / page pool dry)."""
+        With speculation on, rows the drafter has proposals for are
+        pulled out of each partition into a verify dispatch instead
+        (consumed synchronously; they never chain). Returns the pending
+        (unsynced) window dispatches plus the pending verify dispatches;
+        ([], []) when nothing could step (no ACTIVE rows / pool dry)."""
         cfg = self.cfg
         ps, K = cfg.page_size, cfg.decode_window
         greedy: list[tuple[Sequence, int, int]] = []  # (seq, wpos, cap)
@@ -1295,12 +1430,218 @@ class TPUEngine(AsyncEngine):
             seq.stalled_since = 0.0  # progressing (even if window-capped)
             part = sampler if self._needs_sampler(seq) else greedy
             part.append((seq, wpos, cap))
+        spec_parts: list[tuple[list, bool]] = []
+        if self._spec is not None:
+            greedy, g_spec = self._extract_spec_rows(greedy)
+            sampler, s_spec = self._extract_spec_rows(sampler)
+            spec_parts = [(p, fs) for p, fs in ((g_spec, False), (s_spec, True)) if p]
+            if len(self._spec) > 4 * cfg.max_decode_slots:
+                self._spec.retain(
+                    s.request_id for s in self.sched.slots if s is not None
+                )
+        spec_out = [
+            self._dispatch_spec(part, fs) for part, fs in spec_parts
+        ]
         out: list[_PendingDecode] = []
-        solo = bool(greedy) != bool(sampler)
+        # A window is chainable only when it is the iteration's single
+        # decode dispatch — a concurrent verify pass (like a second
+        # partition) means the row set will be re-planned next round.
+        solo = (bool(greedy) != bool(sampler)) and not spec_out
         for part, full_sampler in ((greedy, False), (sampler, True)):
             if part:
                 out.append(self._dispatch_partition(part, full_sampler, solo))
-        return out
+        return out, spec_out
+
+    # ------------------------------------------------------------ speculation
+    def _extract_spec_rows(self, part):
+        """Split one decode partition into (plain rows, speculative
+        rows): a row speculates when the controller wants to probe it
+        AND the drafter proposes at least one token that fits the row's
+        page/model-length capacity. The drafts' KV positions are
+        provisioned here (best effort — a dry pool just shortens the
+        draft; the verify pass still always emits >= 1 token)."""
+        ps = self.cfg.page_size
+        plain, spec = [], []
+        for seq, wpos, cap in part:
+            drafts = (
+                self._spec.propose(seq)
+                if self._spec.wants_draft(seq)
+                else []
+            )
+            if drafts:
+                self.sched.ensure_pages_until(seq, wpos + len(drafts))
+                cap = min(
+                    self.cfg.max_model_len, len(seq.page_ids) * ps
+                ) - 1
+                g = min(len(drafts), cap - wpos, self.cfg.spec_max_draft)
+                if g >= 1:
+                    spec.append((seq, wpos, cap, drafts[:g]))
+                    continue
+            plain.append((seq, wpos, cap))
+        return plain, spec
+
+    def _dispatch_spec(self, part, full_sampler: bool) -> _PendingSpec:
+        """Build + dispatch one batched verify pass: each row feeds its
+        last confirmed token plus its draft tokens at consecutive
+        absolute positions (one chunked-prefill-shaped dispatch per row
+        group). No host sync here; :meth:`_consume_spec` runs in the
+        same iteration."""
+        cfg = self.cfg
+        ps = cfg.page_size
+        rows = cfg.decode_rows_bucket_for(len(part))
+        kb = cfg.spec_draft_bucket_for(max(len(d) for _, _, _, d in part))
+        T = kb + 1
+        tokens = np.zeros((rows, T), np.int32)
+        positions = np.full((rows, T), -1, np.int32)
+        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
+        n_drafts = np.zeros(rows, np.int32)
+        slot_map = np.full(rows, cfg.max_decode_slots, np.int32)
+        seeds = np.zeros(rows, np.int32)
+        temp = np.zeros(rows, np.float32)
+        top_k = np.zeros(rows, np.int32)
+        top_p = np.ones(rows, np.float32)
+        freq = np.zeros(rows, np.float32)
+        pres = np.zeros(rows, np.float32)
+        rep = np.ones(rows, np.float32)
+        stepped: list[tuple[Sequence, int, int]] = []
+        max_pages = 1
+        for r, (seq, wpos, _cap, drafts) in enumerate(part):
+            g = len(drafts)
+            tokens[r, 0] = seq.last_token()
+            tokens[r, 1 : g + 1] = drafts
+            positions[r, : g + 1] = np.arange(wpos, wpos + g + 1)
+            table[r, : len(seq.page_ids)] = seq.page_ids
+            n_drafts[r] = g
+            slot_map[r] = seq.slot
+            max_pages = max(max_pages, (wpos + g) // ps + 1)
+            so = seq.stop.sampling_options
+            seeds[r] = seq.sample_seed & 0x7FFFFFFF
+            temp[r] = so.temperature if so.temperature is not None else 0.0
+            top_k[r] = so.top_k or 0
+            top_p[r] = so.top_p if so.top_p is not None else 1.0
+            freq[r] = so.frequency_penalty or 0.0
+            pres[r] = so.presence_penalty or 0.0
+            rep[r] = so.repetition_penalty or 1.0
+            stepped.append((seq, g, r))
+        want_lp = any(
+            self._wants_logprobs(seq) is not None for seq, _, _ in stepped
+        )
+        fn = self._spec_fn(
+            rows, kb, cfg.page_bucket_for(max_pages), full_sampler, want_lp
+        )
+        self._flush_offloads()
+        if full_sampler:
+            ys, self.k_cache, self.v_cache, self._counts = fn(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(table), jnp.asarray(n_drafts), self._counts,
+                jnp.asarray(slot_map), jnp.asarray(seeds),
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+                jnp.asarray(freq), jnp.asarray(pres), jnp.asarray(rep),
+            )
+        else:
+            ys, self.k_cache, self.v_cache = fn(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(table), jnp.asarray(n_drafts),
+            )
+        self.steps += T
+        self.spec_dispatches += 1
+        get_telemetry().decode_batch_rows.observe(len(part))
+        return _PendingSpec(
+            ys=ys,
+            stepped=stepped,
+            full_sampler=full_sampler,
+            want_lp=want_lp,
+        )
+
+    def _consume_spec(self, pending: _PendingSpec) -> None:
+        """Host sync of one verify pass: the device already computed the
+        acceptance (longest prefix where draft == target, plus the first
+        correction token — :func:`spec_accept_length` /
+        :func:`spec_verify_tokens`, the same rule that gated the
+        on-device penalty counts); the host emits those tokens, rewinds
+        state past rejected positions, and feeds the outcome back to
+        the adaptive controller. The authoritative host ``check_stop``
+        still gates every emitted token (EOS / stop ids / budget),
+        exactly as in decode."""
+        if pending.want_lp:
+            targets, n_emits, lps, top_ids, top_lps = (
+                np.asarray(y) for y in pending.ys
+            )
+        else:
+            targets = np.asarray(pending.ys[0])
+            n_emits = np.asarray(pending.ys[1])
+        tel = get_telemetry()
+        for seq, g, row in pending.stepped:
+            if seq.state is not SeqState.ACTIVE or seq.pending_finish is not None:
+                continue
+            tgt = targets[row]
+            n_emit = int(n_emits[row])
+            accepted = n_emit - 1
+            kept: list[int] = []
+            reason = None
+            for i in range(n_emit):
+                token = int(tgt[i])
+                kept.append(token)
+                seq.tokens.append(token)
+                seq.generated += 1
+                reason = self.sched.check_stop(seq, token)
+                if reason is not None:
+                    break
+            if n_emit - len(kept):
+                # Tokens past a host-detected stop: computed, discarded.
+                self.wasted_steps += n_emit - len(kept)
+                tel.decode_wasted_steps.inc(n_emit - len(kept))
+            seq.spec_dispatches += 1
+            seq.spec_draft_tokens += g
+            seq.spec_accepted_tokens += accepted
+            seq.spec_emitted_tokens += len(kept)
+            self.spec_row_dispatches += 1
+            self.spec_draft_tokens += g
+            self.spec_accepted_tokens += accepted
+            self.spec_emitted_tokens += len(kept)
+            tel.spec_draft_tokens.inc(g)
+            tel.spec_accepted_tokens.inc(accepted)
+            tel.spec_tokens_per_dispatch.observe(len(kept))
+            self._spec.record(seq, proposed=g, accepted=accepted)
+            self.sched.register_full_pages(seq)
+            n_top = self._wants_logprobs(seq)
+            pack = None
+            if n_top is not None and kept:
+                n = len(kept)
+                pack = self._lp_pack(
+                    n_top, lps[row, :n], top_ids[row, :n], top_lps[row, :n]
+                )
+            if kept:
+                now = time.time()
+                if seq.last_emit_at:
+                    tbt = max(now - seq.last_emit_at, 0.0) / len(kept)
+                    tel.time_between_tokens.observe(tbt)
+                seq.last_emit_at = now
+            seq.emit(kept, None, pack)
+            if reason is not None:
+                # No chained window can be in flight over a spec row
+                # (spec rows break the chain), so finishing — and the
+                # page release it implies — is safe right here.
+                self.sched.finish(seq, reason)
+            else:
+                self._rewind_spec_pages(seq)
+
+    def _rewind_spec_pages(self, seq: Sequence) -> None:
+        """Page-granular rewind after a rejection: pages provisioned for
+        draft positions beyond the accepted prefix go back to the pool
+        when the rejection crossed a page boundary. Only unregistered
+        tail pages can be trailing here (registration stops at the last
+        *full* page below the confirmed write head), so the release
+        can't disturb the reuse index; the KV slots inside the kept tail
+        page are overwritten in place as decode advances."""
+        ps = self.cfg.page_size
+        keep = (len(seq.tokens) - 1) // ps + 1
+        if len(seq.page_ids) > keep:
+            extra = seq.page_ids[keep:]
+            del seq.page_ids[keep:]
+            self.kv.release_sequence(extra)
 
     def _dispatch_partition(
         self,
@@ -1413,6 +1754,16 @@ class TPUEngine(AsyncEngine):
             return False  # a capped row's carry is dead but resumable
         if not self._submit_q.empty() or self.sched.waiting:
             return False
+        if self._spec is not None:
+            # Speculative rows break the chain exactly like capacity-
+            # capped rows: a chained window would step token-by-token
+            # past positions a verify pass could cover in one dispatch,
+            # and the drafter must re-plan from the freshly consumed
+            # tokens each round. Rows whose drafting is backed off
+            # (lookup keeps missing) chain normally.
+            for s, _, _ in p.stepped:
+                if s.state is SeqState.ACTIVE and self._spec.wants_draft(s):
+                    return False
         stepped_seqs = {id(seq) for seq, _, _ in p.stepped}
         now = time.time()
         for s in self.sched.slots:
@@ -1601,6 +1952,19 @@ class TPUEngine(AsyncEngine):
         m["kv_lease_reclaimed_pages"] = self.kv.lease_reclaimed_pages
         m["compiled_decode_variants"] = len(self._decode_fns)
         m["compiled_prefill_variants"] = len(self._prefill_fns)
+        # Speculative decoding (docs/speculative.md): acceptance rate =
+        # accepted/draft, tokens-per-dispatch = emitted/dispatches.
+        m["spec_dispatches"] = self.spec_dispatches
+        # Per-ROW verify participations: tokens-per-dispatch on the
+        # per-row basis the sim's service model consumes is
+        # emitted / row_dispatches (a batched dispatch over N rows is N
+        # row-dispatches — dividing by the device-dispatch count would
+        # conflate batch occupancy with speculation speedup).
+        m["spec_row_dispatches"] = self.spec_row_dispatches
+        m["spec_draft_tokens"] = self.spec_draft_tokens
+        m["spec_accepted_tokens"] = self.spec_accepted_tokens
+        m["spec_emitted_tokens"] = self.spec_emitted_tokens
+        m["compiled_spec_variants"] = len(self._spec_fns)
         if self.host_pool is not None:
             m["host_cache_resident"] = self.host_pool.resident
             m["host_cache_hits"] = self.host_pool.hits

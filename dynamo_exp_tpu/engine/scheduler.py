@@ -126,6 +126,14 @@ class Sequence:
     # a request replayed with the same seed reproduces its tokens on any
     # instance, any batch shape (the failover-replay guarantee).
     sample_seed: int = 0
+    # Speculative decoding (docs/speculative.md): this request's verify
+    # dispatches, draft tokens proposed/accepted, and tokens emitted
+    # through speculation — the decode span reports the per-request
+    # tokens-per-dispatch the simulator's service-time fit consumes.
+    spec_dispatches: int = 0
+    spec_draft_tokens: int = 0
+    spec_accepted_tokens: int = 0
+    spec_emitted_tokens: int = 0
 
     @property
     def pos(self) -> int:
@@ -351,6 +359,11 @@ class Scheduler:
                 seq.trace,
                 generated_tokens=seq.generated,
                 finish_reason=getattr(reason, "value", str(reason)),
+                spec_tokens_per_dispatch=(
+                    round(seq.spec_emitted_tokens / seq.spec_dispatches, 4)
+                    if seq.spec_dispatches
+                    else None
+                ),
             )
         seq.state = SeqState.FINISHED
         if seq.slot >= 0 and was_bound:

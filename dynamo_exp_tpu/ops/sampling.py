@@ -97,6 +97,118 @@ def sample_tokens_seeded(
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
+def sample_tokens_seeded_multi(
+    logits: jnp.ndarray,  # [B, T, V] float32
+    seeds: jnp.ndarray,  # [B] int32 per-row sampling seed
+    positions: jnp.ndarray,  # [B, T] int32 absolute position of each fed token
+    temperature: jnp.ndarray,  # [B] float32; <=0 means greedy
+    top_k: jnp.ndarray,  # [B] int32; <=0 disables
+    top_p: jnp.ndarray,  # [B] float32; >=1 disables
+) -> jnp.ndarray:
+    """Multi-position counter-based sampling: one draw per (row, offset)
+    of a T-wide dispatch, each keyed by ``(seeds[b], positions[b, t])``
+    exactly as :func:`sample_tokens_seeded` would key a decode step
+    feeding that position. This is what makes a speculative verify pass
+    (T = draft_len + 1 positions scored in one chunked-prefill-shaped
+    dispatch) emit the *identical* tokens the step-by-step decode window
+    would have — the draw never sees batch shape, window layout, or how
+    many positions share the dispatch. Returns [B, T] int32."""
+    B, T, V = logits.shape
+
+    def rep(x):
+        return jnp.repeat(x, T)
+
+    toks = sample_tokens_seeded(
+        logits.reshape(B * T, V),
+        rep(seeds),
+        positions.reshape(-1),
+        rep(temperature),
+        rep(top_k),
+        rep(top_p),
+    )
+    return toks.reshape(B, T)
+
+
+def spec_accept_length(
+    targets: jnp.ndarray,  # [B, T] target-model tokens per position
+    drafts: jnp.ndarray,  # [B, T-1] draft tokens (-1 padded)
+    n_drafts: jnp.ndarray,  # [B] int32 true draft count per row
+) -> jnp.ndarray:
+    """Tokens emitted per row by one verify dispatch: the longest prefix
+    where the target's token equals the draft fed at the next position,
+    plus the first correction/bonus token — always >= 1, so a
+    speculative row can never stall. Returns [B] int32."""
+    K = drafts.shape[1]
+    idx = jnp.arange(K, dtype=jnp.int32)[None, :]
+    match = (targets[:, :K] == drafts) & (idx < n_drafts[:, None])
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=-1).sum(axis=-1)
+    return accepted + 1
+
+
+def spec_verify_tokens(
+    logits: jnp.ndarray,  # [B, T, V] float32 target logits per fed position
+    drafts: jnp.ndarray,  # [B, T-1] draft tokens fed at offsets 1..T-1
+    n_drafts: jnp.ndarray,  # [B] int32 true draft count per row
+    seeds: jnp.ndarray,  # [B] int32
+    positions: jnp.ndarray,  # [B, T] int32 absolute fed positions (-1 pad)
+    temperature: jnp.ndarray,  # [B] float32; <=0 greedy
+    top_k: jnp.ndarray,  # [B] int32
+    top_p: jnp.ndarray,  # [B] float32
+    counts: jnp.ndarray,  # [B, V] int32 penalty counts at dispatch
+    frequency_penalty: jnp.ndarray,  # [B]
+    presence_penalty: jnp.ndarray,  # [B]
+    repetition_penalty: jnp.ndarray,  # [B]
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The full-sampler verify pass: target tokens for every position of
+    a speculative dispatch with the penalty state threaded *exactly* as
+    the decode window threads it — each step's logits are shaped by the
+    counts of every token emitted so far, and a step's token is counted
+    only while the row is still "alive" (all earlier drafts accepted),
+    so rejected draft positions leave no trace in the counts (the
+    penalty half of the KV/state rewind, docs/speculative.md).
+
+    Covers greedy, seeded, and penalized rows in one code path:
+    temperature <= 0 degrades each draw to argmax and zero penalties
+    make ``apply_penalties`` the identity. Returns (targets [B, T],
+    n_emit [B], new_counts [B, V]); positions with offset >= n_emit are
+    teacher-forced garbage the caller must discard."""
+    B, T, V = logits.shape
+    # Fed "next draft" at step i is drafts[:, i]; the last step has none.
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.full((B, 1), -1, jnp.int32)], axis=1
+    )
+    xs = (
+        jnp.swapaxes(logits, 0, 1),  # [T, B, V]
+        jnp.swapaxes(positions, 0, 1),  # [T, B]
+        jnp.swapaxes(drafts_pad, 0, 1),  # [T, B]
+        jnp.arange(T, dtype=jnp.int32),
+    )
+    alive0 = positions[:, 0] >= 0  # pad rows never emit/count
+
+    def step(carry, x):
+        counts, alive = carry
+        li, pi, di, i = x
+        shaped = apply_penalties(
+            li,
+            counts,
+            frequency_penalty,
+            presence_penalty,
+            repetition_penalty,
+        )
+        tgt = sample_tokens_seeded(
+            shaped, seeds, pi, temperature, top_k, top_p
+        )
+        counts = counts.at[jnp.arange(B), tgt].add(alive.astype(jnp.int32))
+        emit = alive
+        alive = alive & (i < n_drafts) & (tgt == di)
+        return (counts, alive), (tgt, emit)
+
+    (counts, _), (tgts, emits) = jax.lax.scan(step, (counts, alive0), xs)
+    targets = jnp.swapaxes(tgts, 0, 1)
+    n_emit = jnp.sum(emits.astype(jnp.int32), axis=0)
+    return targets, n_emit, counts
+
+
 def apply_penalties(
     logits: jnp.ndarray,  # [B, V]
     output_counts: jnp.ndarray,  # [B, V] int32 — counts of generated tokens

@@ -65,6 +65,22 @@ def parse_args(argv: list[str]):
     p.add_argument("--host-cache-pages", type=int, default=0)
     p.add_argument("--kv-dtype", default="bfloat16", choices=["bfloat16", "float32"])
     p.add_argument("--max-tokens", type=int, default=256, help="default completion cap")
+    # Speculative decoding (docs/speculative.md): multi-token-per-
+    # dispatch decode with a deterministic draft/verify pass — output
+    # streams stay token-identical to the non-speculative run.
+    p.add_argument("--spec", default="off",
+                   help="speculative decoding drafter: off | ngram "
+                        "(prompt-lookup, no second model) | any "
+                        "registered drafter name")
+    p.add_argument("--spec-draft-len", type=int, default=4,
+                   help="initial draft length per row (adapted per row "
+                        "from the rolling acceptance rate)")
+    p.add_argument("--spec-max-draft", type=int, default=8,
+                   help="upper bound the adaptive controller may grow a "
+                        "row's draft length to")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="widest trailing n-gram the prompt-lookup "
+                        "drafter matches")
     p.add_argument("--echo-token-delay-ms", type=float, default=0.0)
     p.add_argument("--request-template", default="",
                    help="JSON file of request defaults (model/temperature/"
@@ -199,6 +215,13 @@ def build_tpu_engine(opts):
         kv_dtype=opts.kv_dtype,
         host_cache_pages=opts.host_cache_pages,
         default_max_tokens=opts.max_tokens,
+        # getattr: callers besides the CLI drive this builder with
+        # duck-typed opts objects (examples/llm TpuWorker) that predate
+        # speculation; absent attributes mean the defaults.
+        spec_mode=getattr(opts, "spec", "off"),
+        spec_draft_len=getattr(opts, "spec_draft_len", 4),
+        spec_max_draft=getattr(opts, "spec_max_draft", 8),
+        spec_ngram=getattr(opts, "spec_ngram", 3),
     )
     engine = TPUEngine(ecfg, params=params)
     return engine, mdc

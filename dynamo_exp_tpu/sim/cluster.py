@@ -700,6 +700,9 @@ class ClusterSim:
             r.errors += self._open
         r.duration_s = self.loop.now
         r.events = self.loop.processed
+        r.accepted_per_dispatch = round(
+            max(self.cfg.service.spec_tokens_per_dispatch, 1.0), 4
+        )
         r.wall_clock_s = round(time.perf_counter() - t0, 3)
         r.chip_seconds = round(self._chip_seconds, 3)
         if r.duration_s > 0:

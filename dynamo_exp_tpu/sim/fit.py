@@ -76,6 +76,17 @@ class ServiceTimeModel:
     itl_s: LatencyDist = field(default_factory=lambda: LatencyDist(0.02))
     batch_congestion: float = 0.25
     provision_s: float = 2.0  # worker add → serving (planner sees this)
+    # Speculative decoding (docs/speculative.md): tokens emitted per
+    # decode dispatch per row (accepted draft prefix + correction).
+    # ``itl_s`` is normalized to the per-*dispatch* interval — equal to
+    # the per-token interval when speculation is off, and the span
+    # fitter multiplies a spec-on span's per-token ITL back up by its
+    # own measured factor (see ``_span_samples``) so the factor is
+    # never baked into ``itl_s`` twice. ``decode_itl`` then divides by
+    # this fitted factor once. 1.0 = speculation off. Learned from
+    # spec-tagged bench lines (``tokens_per_dispatch``) or decode spans
+    # (``spec_tokens_per_dispatch`` attr).
+    spec_tokens_per_dispatch: float = 1.0
 
     def prefill_time(self, prompt_tokens: int, rng) -> float:
         return self.prefill_floor_s + prompt_tokens * self.prefill_token_s.sample(
@@ -86,16 +97,17 @@ class ServiceTimeModel:
         """Per-token interval for one row when ``rows`` of ``slots``
         slots are occupied (sampled once per decode round per row)."""
         base = self.itl_s.sample(rng)
-        if slots <= 1:
-            return base
-        fill = (max(rows, 1) - 1) / max(slots - 1, 1)
-        return base * (1.0 + self.batch_congestion * fill)
+        if slots > 1:
+            fill = (max(rows, 1) - 1) / max(slots - 1, 1)
+            base = base * (1.0 + self.batch_congestion * fill)
+        return base / max(self.spec_tokens_per_dispatch, 1.0)
 
     def planner_hints(self) -> dict:
         """Fitted per-worker service rates the SLO planner can budget
         with (tokens/s at median latency, congestion-free)."""
+        spec = max(self.spec_tokens_per_dispatch, 1.0)
         return {
-            "decode_tokens_per_s": 1.0 / max(self.itl_s.median_s, 1e-9),
+            "decode_tokens_per_s": spec / max(self.itl_s.median_s, 1e-9),
             "prefill_tokens_per_s": 1.0
             / max(self.prefill_token_s.median_s, 1e-9),
             "provision_s": self.provision_s,
@@ -109,12 +121,14 @@ class ServiceTimeModel:
     @classmethod
     def from_spans(cls, paths: Iterable[str | Path]) -> "ServiceTimeModel":
         """Fit from telemetry recorder JSONL (span events)."""
-        prefill_per_token, itl = _span_samples(paths)
+        prefill_per_token, itl, tpd = _span_samples(paths)
         model = cls.default()
         if prefill_per_token:
             model.prefill_token_s = LatencyDist.fit(prefill_per_token)
         if itl:
             model.itl_s = LatencyDist.fit(itl)
+        if tpd:
+            model.spec_tokens_per_dispatch = _median(tpd)
         return model
 
     @classmethod
@@ -123,12 +137,14 @@ class ServiceTimeModel:
     ) -> "ServiceTimeModel":
         """Fit from ``bench.py`` JSON lines, or the driver's
         ``BENCH_r*.json`` wrapper (a dict with a ``parsed`` record)."""
-        prefill_per_token, itl = _bench_samples(paths)
+        prefill_per_token, itl, tpd = _bench_samples(paths)
         model = cls.default()
         if itl:
             model.itl_s = LatencyDist.fit(itl)
         if prefill_per_token:
             model.prefill_token_s = LatencyDist.fit(prefill_per_token)
+        if tpd:
+            model.spec_tokens_per_dispatch = _median(tpd)
         return model
 
     @classmethod
@@ -139,25 +155,36 @@ class ServiceTimeModel:
     ) -> "ServiceTimeModel":
         """Spans win where both sources speak (they are per-request
         measurements; bench numbers are aggregates)."""
-        bench_p, bench_i = (
-            _bench_samples(bench_paths) if bench_paths else ([], [])
+        bench_p, bench_i, bench_t = (
+            _bench_samples(bench_paths) if bench_paths else ([], [], [])
         )
-        span_p, span_i = _span_samples(span_paths) if span_paths else ([], [])
+        span_p, span_i, span_t = (
+            _span_samples(span_paths) if span_paths else ([], [], [])
+        )
         model = cls.default()
         prefill = span_p or bench_p
         itl = span_i or bench_i
+        tpd = span_t or bench_t
         if prefill:
             model.prefill_token_s = LatencyDist.fit(prefill)
         if itl:
             model.itl_s = LatencyDist.fit(itl)
+        if tpd:
+            model.spec_tokens_per_dispatch = _median(tpd)
         return model
+
+
+def _median(samples: list[float]) -> float:
+    s = sorted(samples)
+    return s[len(s) // 2]
 
 
 def _span_samples(
     paths: Iterable[str | Path],
-) -> tuple[list[float], list[float]]:
+) -> tuple[list[float], list[float], list[float]]:
     prefill_per_token: list[float] = []
     itl: list[float] = []
+    tpd: list[float] = []
     for path in paths:
         for line in Path(path).read_text().splitlines():
             line = line.strip()
@@ -183,16 +210,28 @@ def _span_samples(
                 # duration covers toks-1 inter-token intervals (same
                 # convention as the sim's own ITL report).
                 toks = int(attrs.get("generated_tokens") or 0)
+                spec = attrs.get("spec_tokens_per_dispatch")
+                spec_on = isinstance(spec, (int, float)) and spec > 0
                 if toks > 1:
-                    itl.append(dur / (toks - 1))
-    return prefill_per_token, itl
+                    # Normalize to the per-DISPATCH interval: a spec-on
+                    # span's per-token ITL already embeds the multi-
+                    # token speedup, and decode_itl() divides by the
+                    # fitted factor — without the multiply here the
+                    # speedup would be counted twice.
+                    itl.append(
+                        dur / (toks - 1) * (float(spec) if spec_on else 1.0)
+                    )
+                if spec_on:
+                    tpd.append(float(spec))
+    return prefill_per_token, itl, tpd
 
 
 def _bench_samples(
     paths: Iterable[str | Path],
-) -> tuple[list[float], list[float]]:
+) -> tuple[list[float], list[float], list[float]]:
     itl: list[float] = []
     prefill_per_token: list[float] = []
+    tpd: list[float] = []
     for path in paths:
         text = Path(path).read_text().strip()
         records: list[dict] = []
@@ -237,4 +276,12 @@ def _bench_samples(
                 and isl_m is not None
             ):
                 prefill_per_token.append(float(ttft) / int(isl_m.group(1)))
-    return prefill_per_token, itl
+            # Spec-sweep lines (``bench.py --spec-sweep``) carry the
+            # measured tokens-per-dispatch; speculation-off lines carry
+            # None, which is correctly skipped here.
+            spec = rec.get("tokens_per_dispatch")
+            if metric.startswith("spec_decode") and isinstance(
+                spec, (int, float)
+            ) and spec > 0:
+                tpd.append(float(spec))
+    return prefill_per_token, itl, tpd

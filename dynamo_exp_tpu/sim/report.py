@@ -45,6 +45,11 @@ class SimReport:
     capacity_capped: int = 0
     completed_tokens: int = 0
     goodput_tok_s: float = 0.0
+    # Tokens delivered per decode dispatch under the fitted speculative
+    # decoding factor (1.0 = speculation off): `llmctl sim` runs fitted
+    # from spec-tagged telemetry report it so spec-on fleet studies are
+    # labeled with the speedup assumption they were run under.
+    accepted_per_dispatch: float = 1.0
     ttft_p50_s: float | None = None
     ttft_p99_s: float | None = None
     itl_p50_s: float | None = None
@@ -63,11 +68,26 @@ class SimReport:
     def shed_rate(self) -> float:
         return self.shed / self.submitted if self.submitted else 0.0
 
-    def to_dict(self) -> dict:
+    def to_dict(self, include_host_time: bool = False) -> dict:
+        """Serializable view. ``wall_clock_s`` is host wall time around
+        the (simulated-clock) run — the one field that differs between
+        two bit-identical runs — so comparison/serialization drops it
+        by DEFAULT: seeded regression diffs (`make sim`, the
+        determinism suites) compare clean without every caller
+        remembering to pop it. Pass ``include_host_time=True`` for
+        profiling output."""
         d = {k: v for k, v in self.__dict__.items()}
+        if not include_host_time:
+            d.pop("wall_clock_s", None)
         d["shed"] = self.shed
         d["shed_rate"] = round(self.shed_rate, 4)
         return d
 
-    def to_json(self, indent: int | None = None) -> str:
-        return json.dumps(self.to_dict(), indent=indent, default=str)
+    def to_json(
+        self, indent: int | None = None, include_host_time: bool = False
+    ) -> str:
+        return json.dumps(
+            self.to_dict(include_host_time=include_host_time),
+            indent=indent,
+            default=str,
+        )

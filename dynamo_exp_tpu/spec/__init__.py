@@ -1,0 +1,46 @@
+"""Speculative decoding: deterministic draft/verify (docs/speculative.md).
+
+The subsystem has three parts:
+
+- :mod:`~dynamo_exp_tpu.spec.drafter` — the :class:`Drafter` interface
+  and registry. The built-in ``ngram`` drafter is prompt-lookup
+  speculation (match the row's trailing n-gram against its own
+  prompt+generated context, propose the continuation) — no second model
+  needed. A tiny draft *model* plugs in later through the same registry.
+- :mod:`~dynamo_exp_tpu.spec.controller` — :class:`SpecManager`, the
+  per-row adaptive controller: tunes each row's draft length from a
+  rolling acceptance rate and temporarily disables drafting for rows
+  whose lookups keep missing.
+- the engine's batched **verify pass** (``engine/engine.py``): the k
+  draft tokens plus one ride through the target model in a single
+  chunked-prefill-shaped dispatch; the counter-keyed target token at
+  each absolute position decides acceptance, the first correction token
+  comes from the same dispatch, and rejected positions are rewound
+  (page-granular) so no garbage KV survives.
+
+Because every sampled draw is keyed by ``(sample_seed, absolute
+position)`` (ops/sampling.py), acceptance is deterministic by
+construction: with speculation on, every output stream is
+token-identical to the non-speculative run — greedy, seeded, and
+penalized — across any batch/window/draft-length layout.
+"""
+
+from .controller import SpecManager
+from .drafter import (
+    Drafter,
+    NgramDrafter,
+    StaticDrafter,
+    build_drafter,
+    register_drafter,
+    registered_drafters,
+)
+
+__all__ = [
+    "Drafter",
+    "NgramDrafter",
+    "SpecManager",
+    "StaticDrafter",
+    "build_drafter",
+    "register_drafter",
+    "registered_drafters",
+]

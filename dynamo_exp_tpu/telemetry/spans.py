@@ -248,6 +248,27 @@ class Telemetry:
             ["reason"],  # kv_pressure
             registry=self.registry,
         )
+        # Speculative decoding (docs/speculative.md): draft tokens
+        # proposed by the drafter, the prefix the target-model verify
+        # pass accepted, and how many tokens each verify dispatch
+        # delivered (accepted prefix + the correction token).
+        self.spec_draft_tokens = Counter(
+            "dynamo_spec_draft_tokens_total",
+            "Draft tokens proposed to the speculative verify pass",
+            registry=self.registry,
+        )
+        self.spec_accepted_tokens = Counter(
+            "dynamo_spec_accepted_tokens_total",
+            "Draft tokens accepted by the target-model verify pass",
+            registry=self.registry,
+        )
+        self.spec_tokens_per_dispatch = Histogram(
+            "dynamo_spec_tokens_per_dispatch",
+            "Tokens emitted per speculative verify dispatch "
+            "(accepted prefix + correction token)",
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16),
+            registry=self.registry,
+        )
 
     # ------------------------------------------------------------ recorder
     def configure(self, trace_file: str | None) -> None:

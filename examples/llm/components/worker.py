@@ -39,6 +39,11 @@ class TpuWorker:
     # prefill fleet through the work queue + KV transfer plane.
     disagg_mode: str = "none"
     max_local_prefill_length: int = 1000
+    # Speculative decoding (docs/speculative.md): "off" | drafter name.
+    spec: str = "off"
+    spec_draft_len: int = 4
+    spec_max_draft: int = 8
+    spec_ngram: int = 3
 
     def __init__(self):
         self.engine = None
@@ -69,6 +74,10 @@ class TpuWorker:
             host_cache_pages = 0
             max_tokens = 256
             tp = 1
+            spec = self.spec
+            spec_draft_len = self.spec_draft_len
+            spec_max_draft = self.spec_max_draft
+            spec_ngram = self.spec_ngram
 
         self.engine, mdc = build_tpu_engine(_Opts)
         self.engine.start()

@@ -78,9 +78,10 @@ def _pressure_sim(seed: int, **over) -> ClusterSim:
 
 
 def _report_key(report) -> dict:
-    """Everything in the report except host-dependent wall clock."""
+    """to_dict() drops the host-dependent wall clock by default, so the
+    whole serialized report is the comparison key."""
     d = report.to_dict()
-    d.pop("wall_clock_s")
+    assert "wall_clock_s" not in d  # regression: default must stay clean
     return d
 
 
@@ -212,6 +213,80 @@ def test_fit_from_bench_wrapper_json(tmp_path):
     model = ServiceTimeModel.from_bench_json([path])
     assert model.itl_s.median_s == pytest.approx(32 / 64.0)  # rows/tok_s
     assert model.prefill_token_s.median_s == pytest.approx(1.28 / 128)
+
+
+def test_fit_learns_spec_tokens_per_dispatch(tmp_path):
+    """Spec-tagged telemetry scales the modeled decode ITL: bench
+    --spec-sweep lines carry `tokens_per_dispatch`, decode spans carry
+    `spec_tokens_per_dispatch`, and the fitted factor divides every
+    per-token interval (docs/speculative.md)."""
+    import random
+
+    bench = tmp_path / "bench.jsonl"
+    bench.write_text(
+        "\n".join(
+            json.dumps(d)
+            for d in [
+                {
+                    "metric": "spec_decode_tiny_isl96_osl32_repeat_d4",
+                    "value": 100.0,
+                    "tokens_per_dispatch": 2.5,
+                },
+                {  # speculation-off baseline line: no sample
+                    "metric": "spec_decode_tiny_isl96_osl32_repeat_d0",
+                    "value": 80.0,
+                    "tokens_per_dispatch": None,
+                },
+            ]
+        )
+    )
+    model = ServiceTimeModel.from_bench_json([bench])
+    assert model.spec_tokens_per_dispatch == pytest.approx(2.5)
+    base = ServiceTimeModel()
+    rng1, rng2 = random.Random(0), random.Random(0)
+    assert model.decode_itl(1, 8, rng1) == pytest.approx(
+        base.decode_itl(1, 8, rng2) / 2.5
+    )
+    # planner hints see the effective (speculation-scaled) decode rate.
+    assert model.planner_hints()["decode_tokens_per_s"] == pytest.approx(
+        2.5 * base.planner_hints()["decode_tokens_per_s"]
+    )
+
+    spans = tmp_path / "spans.jsonl"
+    spans.write_text(
+        json.dumps(
+            {
+                "type": "span", "stage": "decode", "start": 0.0, "end": 0.8,
+                "attrs": {"generated_tokens": 41,
+                          "spec_tokens_per_dispatch": 3.0},
+            }
+        )
+        + "\n"
+    )
+    # Spans win over bench where both speak (per-request measurements).
+    both = ServiceTimeModel.from_telemetry(
+        span_paths=[spans], bench_paths=[bench]
+    )
+    assert both.spec_tokens_per_dispatch == pytest.approx(3.0)
+    # No double-counting: a spec-on span's per-token ITL already embeds
+    # the speedup, so the fitter normalizes it to the per-dispatch
+    # interval (x3.0) and decode_itl's /3.0 lands back on the measured
+    # per-token interval — NOT measured/3.
+    assert both.itl_s.median_s == pytest.approx(0.8 / 40 * 3.0)
+    assert both.decode_itl(1, 1, random.Random(0)) == pytest.approx(0.8 / 40)
+
+
+def test_report_accepted_per_dispatch_and_host_time_opt_in():
+    """SimReport serialization: the fitted speculation factor is
+    reported, and host wall clock stays out unless asked for."""
+    from dynamo_exp_tpu.sim.report import SimReport
+
+    r = SimReport(wall_clock_s=1.23, accepted_per_dispatch=2.0)
+    d = r.to_dict()
+    assert "wall_clock_s" not in d
+    assert d["accepted_per_dispatch"] == 2.0
+    assert r.to_dict(include_host_time=True)["wall_clock_s"] == 1.23
+    assert '"wall_clock_s"' not in r.to_json()
 
 
 def test_latency_dist_deterministic_and_lognormal():

@@ -1,0 +1,476 @@
+"""Speculative decoding: deterministic draft/verify (docs/speculative.md).
+
+The load-bearing guarantee, proven on the CPU mesh: with speculation on,
+every output stream is **token-identical** to the non-speculative run —
+greedy, seeded, and penalized — across batch occupancies, draft lengths,
+mid-window EOS, and preempt→resume under KV pressure. Plus units for the
+n-gram prompt-lookup drafter, the adaptive controller, the
+multi-position counter-keyed sampler, page rewind accounting, and the
+acceptance telemetry. Compile-heavy identity matrices are ``slow``
+(excluded from the time-boxed tier-1 lane, still in make test/nightly).
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+from dynamo_exp_tpu.models import TINY
+from dynamo_exp_tpu.parallel import single_device_mesh
+from dynamo_exp_tpu.protocols.common import BackendInput, SamplingOptions
+
+from .test_engine import greedy_oracle
+
+pytestmark = pytest.mark.spec
+
+PS = 8
+REPEAT_PROMPT = [5, 9, 17, 3] * 5  # gives the n-gram lookup something to hit
+
+
+def make_engine(spec="ngram", **kw) -> TPUEngine:
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=kw.pop("max_decode_slots", 4),
+        page_size=PS,
+        num_pages=kw.pop("num_pages", 64),
+        max_model_len=kw.pop("max_model_len", 128),
+        eos_token_ids=kw.pop("eos_token_ids", []),
+        # Default (bfloat16) KV: greedy_oracle runs the same dtype, so
+        # engine-vs-oracle comparisons are exact.
+        spec_mode=spec,
+        **kw,
+    )
+    return TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+
+
+async def run(eng, prompt, max_tokens, stop_ids=(), **sampling):
+    b = BackendInput(token_ids=list(prompt))
+    b.stop_conditions.max_tokens = max_tokens
+    b.stop_conditions.ignore_eos = not stop_ids
+    b.stop_conditions.stop_token_ids = list(stop_ids)
+    if sampling:
+        b.sampling_options = SamplingOptions(**sampling)
+    stream = await eng.generate(b.to_dict())
+    tokens, final = [], None
+    async for item in stream:
+        tokens.extend(item.get("token_ids", []))
+        if item.get("finish_reason"):
+            final = item
+    return tokens, final
+
+
+@pytest.fixture(scope="module")
+def plain_engine():
+    eng = make_engine(spec="off")
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+@pytest.fixture(scope="module")
+def spec_engine():
+    eng = make_engine(spec="ngram")
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+# ------------------------------------------------------------ drafter units
+def test_ngram_drafter_proposes_continuation_of_most_recent_match():
+    from dynamo_exp_tpu.spec import NgramDrafter
+
+    d = NgramDrafter(ngram_max=3, ngram_min=1)
+    # trailing [1,2,3] occurred earlier, followed by [4,5]
+    assert d.propose([1, 2, 3, 4, 5, 9, 1, 2, 3], 2) == [4, 5]
+    # truncates to max_len
+    assert d.propose([1, 2, 3, 4, 5, 9, 1, 2, 3], 1) == [4]
+    # two occurrences: the MOST RECENT match's continuation wins
+    toks = [1, 2, 7, 7, 1, 2, 8, 8, 1, 2]
+    assert d.propose(toks, 2) == [8, 8]
+    # no repeated n-gram (and no repeated unigram): no proposal
+    assert d.propose([1, 2, 3, 4, 5], 4) == []
+    # unigram fallback when wider n-grams miss
+    assert d.propose([9, 1, 5, 2, 5], 1) == [2]
+
+
+def test_drafter_registry_and_static():
+    from dynamo_exp_tpu.spec import (
+        StaticDrafter,
+        build_drafter,
+        registered_drafters,
+    )
+
+    assert "ngram" in registered_drafters()
+    with pytest.raises(ValueError, match="unknown drafter"):
+        build_drafter("no-such", None)
+    s = StaticDrafter([7, 8, 9])
+    assert s.propose([1, 2], 2) == [7, 8]
+
+
+# --------------------------------------------------------- controller units
+class _FakeSeq:
+    def __init__(self, tokens, request_id="r1"):
+        self.tokens = list(tokens)
+        self.request_id = request_id
+
+
+def _manager(**over):
+    from dynamo_exp_tpu.spec import SpecManager
+
+    cfg = EngineConfig(model=TINY, spec_mode="ngram", **over)
+    return SpecManager(cfg)
+
+
+def test_controller_grows_and_shrinks_draft_length():
+    m = _manager(spec_draft_len=2, spec_min_draft=1, spec_max_draft=8)
+    seq = _FakeSeq([1, 2, 1, 2])
+    for _ in range(4):  # sustained full acceptance: length doubles to max
+        m.record(seq, proposed=m.draft_len(seq), accepted=m.draft_len(seq))
+    assert m.draft_len(seq) == 8
+    for _ in range(6):  # sustained rejection: collapses to the floor
+        m.record(seq, proposed=m.draft_len(seq), accepted=0)
+    assert m.draft_len(seq) == 1
+
+
+def test_controller_miss_backoff_reprobes_after_growth():
+    m = _manager(spec_miss_limit=2, spec_retry_tokens=4)
+    seq = _FakeSeq([1, 2, 3, 4, 5])  # nothing for the lookup to match
+    assert m.wants_draft(seq)
+    assert m.propose(seq) == []
+    assert m.wants_draft(seq)  # one miss: still probing
+    assert m.propose(seq) == []
+    assert not m.wants_draft(seq)  # hit the miss limit: backed off
+    seq.tokens += [6, 7, 8, 9]  # context grew past the retry point
+    assert m.wants_draft(seq)
+    # ...and the new context actually repeats now -> proposal resumes
+    seq.tokens = [1, 2, 9, 9, 1, 2]
+    assert m.propose(seq) != []
+
+
+def test_controller_retain_drops_finished_rows():
+    m = _manager()
+    m.propose(_FakeSeq([1, 2], "a"))
+    m.propose(_FakeSeq([1, 2], "b"))
+    assert len(m) == 2
+    m.retain({"b"})
+    assert len(m) == 1
+
+
+def test_adaptation_never_changes_tokens_only_dispatch_shape():
+    """The controller is a perf knob, not a correctness one: whatever
+    draft length it picks, the verify pass emits the target model's own
+    tokens — proven end-to-end by every identity test in this file
+    running with adaptation ON (the engine default)."""
+    cfg = EngineConfig(model=TINY, spec_mode="ngram")
+    assert cfg.spec_adaptive
+
+
+# ------------------------------------------------------------- config units
+def test_dyn_spec_env_toggle(monkeypatch):
+    monkeypatch.setenv("DYN_SPEC", "ngram")
+    assert EngineConfig(model=TINY).spec_mode == "ngram"
+    monkeypatch.setenv("DYN_SPEC", "1")
+    assert EngineConfig(model=TINY).spec_mode == "ngram"
+    # Falsy spellings leave speculation off (not parsed as drafter names).
+    for falsy in ("0", "false", "off", "no"):
+        monkeypatch.setenv("DYN_SPEC", falsy)
+        assert EngineConfig(model=TINY).spec_mode == "off", falsy
+    monkeypatch.delenv("DYN_SPEC")
+    assert EngineConfig(model=TINY).spec_mode == "off"
+
+
+def test_spec_draft_bucket_policy():
+    cfg = EngineConfig(model=TINY, spec_max_draft=8)
+    assert [cfg.spec_draft_bucket_for(n) for n in (1, 2, 3, 4, 5, 8)] == [
+        2, 2, 4, 4, 8, 8,
+    ]
+    with pytest.raises(ValueError, match="spec draft bounds"):
+        EngineConfig(model=TINY, spec_min_draft=4, spec_max_draft=2)
+
+
+# ----------------------------------------------------------- sampling units
+def test_multi_position_sampling_matches_per_position_draws():
+    from dynamo_exp_tpu.ops.sampling import (
+        sample_tokens_seeded,
+        sample_tokens_seeded_multi,
+    )
+
+    rs = np.random.RandomState(0)
+    B, T, V = 3, 4, 32
+    logits = jnp.asarray(rs.randn(B, T, V).astype(np.float32))
+    seeds = jnp.asarray([11, 22, 33], jnp.int32)
+    positions = jnp.asarray(rs.randint(0, 100, size=(B, T)), jnp.int32)
+    temp = jnp.asarray([0.0, 0.8, 1.2], jnp.float32)  # row 0 greedy
+    top_k = jnp.asarray([0, 5, 0], jnp.int32)
+    top_p = jnp.asarray([1.0, 1.0, 0.9], jnp.float32)
+    multi = np.asarray(
+        sample_tokens_seeded_multi(logits, seeds, positions, temp, top_k, top_p)
+    )
+    for t in range(T):
+        single = np.asarray(
+            sample_tokens_seeded(
+                logits[:, t], seeds, positions[:, t], temp, top_k, top_p
+            )
+        )
+        assert (multi[:, t] == single).all()
+
+
+def test_spec_accept_length_rule():
+    from dynamo_exp_tpu.ops.sampling import spec_accept_length
+
+    targets = jnp.asarray([[4, 5, 6, 7], [4, 9, 6, 7], [1, 2, 3, 4]])
+    drafts = jnp.asarray([[4, 5, 6], [4, 5, 6], [9, 2, 3]])
+    n_drafts = jnp.asarray([3, 3, 2])
+    # row 0: all 3 accepted + bonus; row 1: mismatch at i=1 -> 2 emitted;
+    # row 2: first draft wrong -> correction only.
+    assert np.asarray(
+        spec_accept_length(targets, drafts, n_drafts)
+    ).tolist() == [4, 2, 1]
+
+
+def test_spec_verify_tokens_counts_only_emitted_positions():
+    """Penalty-state rewind: counts gained by rejected positions must
+    not survive the scan — only the emitted prefix is counted."""
+    from dynamo_exp_tpu.ops.sampling import spec_verify_tokens
+
+    B, T, V = 1, 3, 8
+    # Greedy row (temp 0): argmax targets are [3, 3, 3].
+    logits = np.full((B, T, V), -5.0, np.float32)
+    logits[:, :, 3] = 5.0
+    drafts = jnp.asarray([[3, 0]], jnp.int32)  # second draft wrong
+    targets, n_emit, counts = spec_verify_tokens(
+        jnp.asarray(logits),
+        drafts,
+        jnp.asarray([2], jnp.int32),
+        jnp.asarray([7], jnp.int32),
+        jnp.asarray([[10, 11, 12]], jnp.int32),
+        jnp.asarray([0.0], jnp.float32),
+        jnp.asarray([0], jnp.int32),
+        jnp.asarray([1.0], jnp.float32),
+        jnp.zeros((B, V), jnp.int32),
+        jnp.asarray([0.0], jnp.float32),
+        jnp.asarray([0.0], jnp.float32),
+        jnp.asarray([1.0], jnp.float32),
+    )
+    assert np.asarray(targets)[0].tolist() == [3, 3, 3]
+    assert int(n_emit[0]) == 2  # draft 0 accepted, draft 1 rejected
+    # token 3 counted exactly twice: the accepted draft + the correction
+    # — the rejected position's draw left no trace.
+    assert int(np.asarray(counts)[0, 3]) == 2
+    assert int(np.asarray(counts)[0].sum()) == 2
+
+
+# -------------------------------------------------------- engine: identity
+async def test_greedy_identity_and_speculation_engaged(spec_engine):
+    """Spec-on greedy output equals the step-by-step oracle, and the
+    repetitive prompt provably engaged speculation (drafts accepted,
+    > 1 token per verify dispatch on average)."""
+    accepted0 = spec_engine.spec_accepted_tokens
+    tokens, final = await run(spec_engine, REPEAT_PROMPT, 16)
+    assert tokens == greedy_oracle(REPEAT_PROMPT, 16)
+    assert final["finish_reason"] == "length"
+    assert final["completion_tokens"] == 16
+    assert spec_engine.spec_accepted_tokens > accepted0
+    m = spec_engine.metrics()
+    assert m["spec_dispatches"] >= 1
+    # Per-ROW basis (what bench/sim consume): tokens per verify
+    # participation, not per batched device dispatch.
+    assert m["spec_row_dispatches"] >= m["spec_dispatches"]
+    assert m["spec_emitted_tokens"] / m["spec_row_dispatches"] > 1.0
+
+
+async def test_seeded_identity(plain_engine, spec_engine):
+    so = dict(temperature=0.9, top_p=0.9, seed=777)
+    want, _ = await run(plain_engine, REPEAT_PROMPT, 16, **so)
+    got, _ = await run(spec_engine, REPEAT_PROMPT, 16, **so)
+    assert got == want
+
+
+async def test_penalized_identity(plain_engine, spec_engine):
+    so = dict(
+        temperature=0.8,
+        seed=424242,
+        frequency_penalty=0.4,
+        presence_penalty=0.2,
+        repetition_penalty=1.15,
+    )
+    want, _ = await run(plain_engine, REPEAT_PROMPT, 20, **so)
+    got, _ = await run(spec_engine, REPEAT_PROMPT, 20, **so)
+    assert got == want
+
+
+async def test_mid_window_eos_identity(plain_engine, spec_engine):
+    """A stop token discovered inside a verify pass's emitted prefix
+    must end both streams at the same token with the same reason."""
+    free, _ = await run(plain_engine, REPEAT_PROMPT, 16)
+    stop = free[4]  # force a stop partway through generation
+    want, wfinal = await run(plain_engine, REPEAT_PROMPT, 16, stop_ids=[stop])
+    got, gfinal = await run(spec_engine, REPEAT_PROMPT, 16, stop_ids=[stop])
+    assert got == want
+    assert want[-1] == stop and len(want) < 16
+    assert wfinal["finish_reason"] == gfinal["finish_reason"] == "eos"
+
+
+async def test_mixed_batch_identity(plain_engine, spec_engine):
+    """Greedy and sampled rows sharing the engine (split verify
+    partitions + plain windows) each stay identical to their solo
+    non-speculative runs."""
+    g_prompt = REPEAT_PROMPT
+    s_prompt = [7, 3, 19, 7, 3, 19, 7, 3, 19, 28]
+    so = dict(temperature=0.9, top_p=0.9, seed=123)
+    want_g, _ = await run(plain_engine, g_prompt, 12)
+    want_s, _ = await run(plain_engine, s_prompt, 12, **so)
+    got_g, got_s = await asyncio.gather(
+        run(spec_engine, g_prompt, 12),
+        run(spec_engine, s_prompt, 12, **so),
+    )
+    assert got_g[0] == want_g
+    assert got_s[0] == want_s
+
+
+async def test_no_page_leak_and_rewind_accounting():
+    """Verify-pass page provisioning must rewind: after every stream
+    finishes, the pool is whole (free == reclaimable + untouched), even
+    though rejected drafts had pages provisioned past the accepted
+    prefix."""
+    eng = make_engine(spec="ngram", num_pages=32)
+    eng.start()
+    try:
+        tokens, _ = await run(eng, REPEAT_PROMPT, 16)
+        assert tokens == greedy_oracle(REPEAT_PROMPT, 16)
+        for _ in range(200):
+            if not eng.sched.has_work():
+                break
+            await asyncio.sleep(0.01)
+        assert eng.kv.free_pages == eng.kv.num_pages
+        assert eng.spec_draft_tokens >= eng.spec_accepted_tokens
+    finally:
+        eng.stop()
+
+
+async def test_spec_telemetry_counters_exposed(spec_engine):
+    """Acceptance counters ride /metrics and the metrics() mirrors."""
+    from dynamo_exp_tpu.telemetry import get_telemetry
+
+    await run(spec_engine, REPEAT_PROMPT, 8)
+    m = spec_engine.metrics()
+    for key in (
+        "spec_dispatches",
+        "spec_row_dispatches",
+        "spec_draft_tokens",
+        "spec_accepted_tokens",
+        "spec_emitted_tokens",
+        "compiled_spec_variants",
+    ):
+        assert key in m
+    assert m["compiled_spec_variants"] == len(spec_engine._spec_fns) > 0
+    rendered = get_telemetry().render().decode()
+    assert "dynamo_spec_draft_tokens_total" in rendered
+    assert "dynamo_spec_accepted_tokens_total" in rendered
+    assert "dynamo_spec_tokens_per_dispatch" in rendered
+
+
+# --------------------------------------------- slow: full identity matrices
+@pytest.mark.slow  # compile-heavy: one engine per draft length
+@pytest.mark.parametrize("draft_len", [2, 4, 8])
+async def test_identity_matrix_across_draft_lengths(plain_engine, draft_len):
+    """Greedy AND seeded AND penalized, 3 seeds each, at the pinned
+    draft length — token-identical to the non-speculative engine."""
+    eng = make_engine(
+        spec="ngram",
+        spec_draft_len=draft_len,
+        spec_max_draft=draft_len,
+        spec_adaptive=False,
+    )
+    eng.start()
+    try:
+        want, _ = await run(plain_engine, REPEAT_PROMPT, 16)
+        got, _ = await run(eng, REPEAT_PROMPT, 16)
+        assert got == want, f"greedy diverged at draft_len={draft_len}"
+        for seed in (7, 21, 1337):
+            so = dict(temperature=0.9, top_p=0.9, seed=seed)
+            want, _ = await run(plain_engine, REPEAT_PROMPT, 14, **so)
+            got, _ = await run(eng, REPEAT_PROMPT, 14, **so)
+            assert got == want, f"seeded diverged seed={seed} d={draft_len}"
+            pso = dict(
+                temperature=0.8,
+                seed=seed,
+                frequency_penalty=0.3,
+                repetition_penalty=1.1,
+            )
+            want, _ = await run(plain_engine, REPEAT_PROMPT, 14, **pso)
+            got, _ = await run(eng, REPEAT_PROMPT, 14, **pso)
+            assert got == want, f"penalized diverged seed={seed} d={draft_len}"
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow  # wide row buckets: extra compiled variants
+async def test_identity_at_mixed_occupancies(plain_engine):
+    """Occupancy 1 vs 3-of-4 slots: per-row streams never see the batch
+    around them (the compaction + counter-keyed sampling invariant,
+    now through verify dispatches too)."""
+    eng = make_engine(spec="ngram")
+    eng.start()
+    try:
+        prompts = [
+            REPEAT_PROMPT,
+            [11, 4, 11, 4, 11, 4, 9],
+            [3, 19, 28, 3, 19, 28, 3, 19],
+        ]
+        sos = [
+            {},
+            dict(temperature=0.9, top_p=0.9, seed=55),
+            dict(temperature=0.7, seed=66, frequency_penalty=0.2),
+        ]
+        solos = [
+            (await run(plain_engine, p, 12, **so))[0]
+            for p, so in zip(prompts, sos)
+        ]
+        # occupancy 1
+        got, _ = await run(eng, prompts[0], 12, **sos[0])
+        assert got == solos[0]
+        # occupancy 3 (mixed greedy/seeded/penalized rows)
+        results = await asyncio.gather(
+            *[run(eng, p, 12, **so) for p, so in zip(prompts, sos)]
+        )
+        for i, (got, _) in enumerate(results):
+            assert got == solos[i], f"row {i} diverged at occupancy 3"
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow  # pressure engine + oracle replays: compile-heavy
+async def test_preempt_resume_identity_with_spec_on():
+    """KV-pressure preemption under speculation: the preempted stream
+    resumes as a deterministic continuation and stays token-identical
+    to the un-pressured run (the same oracle trick as test_overload:
+    one request alone never stalls on this pool, and counter-based
+    sampling makes tokens pool-independent)."""
+    eng = make_engine(
+        spec="ngram",
+        num_pages=8,
+        preempt_stall_grace_s=0.05,
+    )
+    eng.start()
+    try:
+        prompts = [REPEAT_PROMPT[:8], [9, 2, 9, 2, 9, 2, 9, 5]]
+        sos = [{}, dict(temperature=0.9, seed=99)]
+        n = 40
+        solos = []
+        for p, so in zip(prompts, sos):  # sequential: no pressure
+            toks, _ = await run(eng, p, n, **so)
+            assert len(toks) == n
+            solos.append(toks)
+        preempted0 = eng.preempted
+        results = await asyncio.gather(
+            *[run(eng, p, n, **so) for p, so in zip(prompts, sos)]
+        )
+        assert eng.preempted > preempted0, "pool never pressured?"
+        for i, (toks, final) in enumerate(results):
+            assert toks == solos[i], f"stream {i} diverged across preemption"
+            assert final["finish_reason"] == "length"
+    finally:
+        eng.stop()

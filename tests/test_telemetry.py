@@ -445,3 +445,34 @@ async def test_card_sweep_still_removes_stale_cards():
     with pytest.raises(asyncio.CancelledError):
         await task
     assert await store.get(MDC_BUCKET, "stale") is None
+
+
+# ---------------------------------------------------- metric doc-sync guard
+def test_every_registered_metric_name_is_documented():
+    """Doc-sync guard: every ``dynamo_*`` metric registered by the
+    telemetry hub must appear in docs/observability.md — new counters
+    land with their documentation or not at all (this is exactly the
+    drift a PR adding counters would otherwise start)."""
+    import os
+
+    from prometheus_client import CollectorRegistry
+
+    from dynamo_exp_tpu.telemetry.spans import Telemetry
+
+    doc_path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "observability.md"
+    )
+    with open(doc_path) as f:
+        doc = f.read()
+    hub = Telemetry(CollectorRegistry())
+    missing = []
+    for family in hub.registry.collect():
+        # The client lib reports counters by base name; the exposition
+        # (and the docs) use the _total suffix.
+        name = family.name + ("_total" if family.type == "counter" else "")
+        if name.startswith("dynamo_") and name not in doc:
+            missing.append(name)
+    assert not missing, (
+        f"metrics registered in telemetry/ but undocumented in "
+        f"docs/observability.md: {sorted(missing)}"
+    )
