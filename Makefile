@@ -23,7 +23,7 @@ SIM_SEED_SETS := 7,21,1337 3,9,27
 # must stay token-identical with spec on (docs/speculative.md).
 SPEC_SEED_SETS := 7,21,1337
 
-.PHONY: test pre-merge nightly chaos sim sim-scale lint
+.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint
 
 test:
 	$(PYTEST) tests/ -q -m "not tpu and not weekly"
@@ -68,6 +68,17 @@ sim:
 
 sim-scale:
 	$(PYTEST) tests/test_sim.py -q -m "sim and slow"
+
+# Flight-recorder demo (docs/observability.md): tiny engine, SIGUSR1,
+# render the dump with `llmctl flight`.
+flight:
+	env JAX_PLATFORMS=cpu python examples/flight_demo.py
+
+# Profiler-overhead smoke: the instrumented decode path must perform
+# ZERO additional host syncs per window (sync-spy shim, not wall clock
+# — CPU timing is load-sensitive).
+profile-smoke:
+	$(PYTEST) tests/test_dispatch_profile.py -q -k overhead
 
 lint:
 	ruff check dynamo_exp_tpu/ tests/ bench.py __graft_entry__.py

@@ -95,6 +95,27 @@ def _roofline_tok_s(params, batch: int) -> float:
     return HBM_GBPS * 1e9 / weight_bytes * batch
 
 
+def _dispatch_stats(engine) -> dict:
+    """Per-kind dispatch-timing percentiles (p50/p99 host-gap and
+    in-flight) from the engine's dispatch profiler, attached to every
+    bench JSON line — so ``sim/fit.py --fit-bench`` can fit service
+    times without a span file (it reads ``dispatch.decode`` together
+    with the line's ``decode_window``). Kinds that never dispatched in
+    the run keep count 0 / null percentiles."""
+    disp = engine.metrics().get("dispatch") or {}
+    keep = (
+        "count",
+        "host_gap_p50_s",
+        "host_gap_p99_s",
+        "in_flight_p50_s",
+        "in_flight_p99_s",
+    )
+    return {
+        kind: {f: stats.get(f) for f in keep}
+        for kind, stats in disp.items()
+    }
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache: repeat bench runs (and the
     driver's end-of-round run) skip the 20-40s per-variant compiles, so
@@ -186,6 +207,7 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
 
     tok_s, p50_ttft = asyncio.run(burst())
     roofline = _roofline_tok_s(engine.params, concurrency)
+    dispatch = _dispatch_stats(engine)
     engine.stop()
     return {
         "metric": f"decode_throughput_{MODEL}_isl{isl}_osl{osl}_c{concurrency}",
@@ -193,6 +215,8 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
         "unit": "tok/s",
         "vs_baseline": round(tok_s / roofline, 4),
         "p50_ttft_s": round(p50_ttft, 3),
+        "decode_window": engine.cfg.decode_window,
+        "dispatch": dispatch,
     }
 
 
@@ -280,6 +304,8 @@ def run_occupancy_sweep(
                 "compiled_prefill_variants": m["compiled_prefill_variants"],
                 "wasted_steps": engine.wasted_steps - wasted0,
                 "kv_page_moves": engine.kv_page_moves - moves0,
+                "decode_window": engine.cfg.decode_window,
+                "dispatch": _dispatch_stats(engine),
             }
         )
     engine.stop()
@@ -395,6 +421,8 @@ def run_overload_sweep(
             if ttfts
             else None,
             "preemptions": engine.preempted - preempted0,
+            "decode_window": engine.cfg.decode_window,
+            "dispatch": _dispatch_stats(engine),
         }
 
     out = []
@@ -558,6 +586,8 @@ def run_spec_sweep(
                     )
                     if dispatches
                     else None,
+                    "decode_window": engine.cfg.decode_window,
+                    "dispatch": _dispatch_stats(engine),
                 }
             )
             engine.stop()
@@ -638,6 +668,8 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
         "vs_baseline": round((p50(cold) / p50(warm)) / 3.0, 4),  # ref: 3x
         "p50_ttft_cold_s": round(p50(cold), 3),
         "p50_ttft_warm_s": round(p50(warm), 3),
+        "decode_window": engine.cfg.decode_window,
+        "dispatch": _dispatch_stats(engine),
     }
 
 

@@ -109,6 +109,23 @@ class EngineConfig:
     # stops being probed until its context grows by spec_retry_tokens.
     spec_miss_limit: int = 4
     spec_retry_tokens: int = 32
+    # Per-dispatch device profiling (docs/observability.md): host-gap /
+    # in-flight / compile timing per dispatch kind, measured at the
+    # loop's existing sync points — zero added host syncs. Off only for
+    # A/B overhead measurement (the sync-spy smoke test).
+    profile_dispatches: bool = True
+    # Engine flight recorder (docs/observability.md): bounded ring of
+    # loop events dumped on watchdog stall / SIGUSR1 / loop crash.
+    flight_events: bool = True
+    flight_capacity: int = 2048
+    # Dump target; empty resolves to $DYN_FLIGHT_DUMP or a per-process
+    # file under the tempdir (telemetry.flight.default_dump_path).
+    flight_dump_path: str = ""
+    # Watchdog: dump the flight ring + a scheduler/slot/page snapshot
+    # when the loop has made no progress while work is queued for this
+    # long. Generous default: a cold compile of a big variant stalls
+    # the loop thread legitimately for seconds. <= 0 disables.
+    watchdog_stall_s: float = 30.0
     # Disaggregation KV-handoff lease TTL: extracted prompt pages stay
     # pinned in HBM this long awaiting the decode worker's delivery ack;
     # the engine-loop reaper reclaims orphans (decode instance died

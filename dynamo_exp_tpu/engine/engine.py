@@ -77,6 +77,14 @@ from ..parallel.mesh import build_mesh
 from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from ..telemetry import current_trace, get_telemetry
+from ..telemetry.dispatch import DispatchProfiler
+from ..telemetry.flight import (
+    FlightRecorder,
+    Watchdog,
+    default_dump_path,
+    register_dumper,
+    unregister_dumper,
+)
 from .config import EngineConfig
 from .kv_manager import KvEvent, KvPageManager
 from .offload import CopyStream, HostKvPool
@@ -115,6 +123,9 @@ class _PendingDecode:
     # (seeds, temp, top_k, top_p, f, p, r) np arrays, reused by a chain.
     sampler_args: tuple | None = None
     slot_map: object | None = None  # np [rows] (sampler variants only)
+    # Dispatch-profiler stamp (monotonic, taken right after the dispatch
+    # call returned): the consume's existing host sync closes the pair.
+    dispatched_at: float = 0.0
 
 
 @dataclass
@@ -124,6 +135,7 @@ class _PendingPrefill:
     ys: tuple
     completed: list  # [(row, Sequence)] rows whose prompt finished
     want_lp: bool
+    dispatched_at: float = 0.0  # dispatch-profiler stamp
 
 
 @dataclass
@@ -139,6 +151,7 @@ class _PendingSpec:
     stepped: list  # [(Sequence, n_drafts, row)]
     full_sampler: bool
     want_lp: bool
+    dispatched_at: float = 0.0  # dispatch-profiler stamp
 
 
 class TPUEngine(AsyncEngine):
@@ -207,7 +220,19 @@ class TPUEngine(AsyncEngine):
             host_pool=self.host_pool,
             on_evict=on_evict,
         )
-        self.sched = Scheduler(cfg, self.kv)
+        # Observability (docs/observability.md): per-dispatch profiler
+        # (host gap vs in-flight, compile attribution — pure timestamps
+        # at the loop's existing sync points) and the flight recorder
+        # ring the watchdog/SIGUSR1/crash paths dump.
+        self.profiler = (
+            DispatchProfiler(get_telemetry()) if cfg.profile_dispatches else None
+        )
+        self.flight = (
+            FlightRecorder(cfg.flight_capacity) if cfg.flight_events else None
+        )
+        self.sched = Scheduler(cfg, self.kv, flight=self.flight)
+        if self.profiler is not None:
+            self.sched.span_attrs = self._decode_span_attrs
 
         # Multi-page movement kernels, shared by the G2 offload tier and
         # the disaggregation KV handoff (gather → wire / wire → inject).
@@ -272,6 +297,15 @@ class TPUEngine(AsyncEngine):
         self.steps = 0  # decode step counter (metrics)
         self._last_gauge_pub = 0.0  # telemetry gauge throttle
         self._last_reap = 0.0  # waiting-deque reap throttle
+        # Watchdog progress: bumped once per loop iteration that did
+        # real work (dispatch/consume/admit). Frozen counter + queued
+        # work past the grace = dump the flight ring.
+        self._progress_mark = 0
+        self._watchdog: Watchdog | None = None
+        self._flight_handle: int | None = None
+        # Dispatch stamp of the last page-move gather (engine-loop
+        # local; the caller's sync consumes it in the same call chain).
+        self._last_move_t = 0.0
         # Chained decode: the dispatched-but-unconsumed window (if any).
         self._inflight: _PendingDecode | None = None
         # Occupancy/movement counters (mirrored to /metrics counters and
@@ -646,10 +680,28 @@ class TPUEngine(AsyncEngine):
             target=self._loop, name="tpu-engine-loop", daemon=True
         )
         self._thread.start()
+        if self.flight is not None:
+            self._flight_handle = register_dumper(self._dump_flight)
+            if self.cfg.watchdog_stall_s > 0 and self._watchdog is None:
+                self._watchdog = Watchdog(
+                    self.cfg.watchdog_stall_s,
+                    progress=lambda: self._progress_mark,
+                    has_work=lambda: (
+                        self.sched.has_work() or not self._submit_q.empty()
+                    ),
+                    dump_fn=self._dump_flight,
+                )
+                self._watchdog.start()
 
     def stop(self) -> None:
         self._running = False
         self._wake.set()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+        if self._flight_handle is not None:
+            unregister_dumper(self._flight_handle)
+            self._flight_handle = None
         if self._thread:
             self._thread.join(timeout=30)
             self._thread = None
@@ -840,10 +892,13 @@ class TPUEngine(AsyncEngine):
                     prev, self._inflight = self._inflight, nxt
                     self._consume_decode(prev)
                     self._maybe_publish_gauges()
+                    self._progress_mark += 1  # consumed a window
                     if self._inflight is not None:
                         continue
                     # Chain broken (arrivals / prefill / stop / dry
                     # pool): fall through to the full scheduling path.
+                    if self.flight is not None:
+                        self.flight.record("chain_break")
                 if not self.sched.has_work() and self._submit_q.empty():
                     # Flush buffered evictions before idling (the host
                     # tier must see them even with no next dispatch) and
@@ -852,6 +907,10 @@ class TPUEngine(AsyncEngine):
                     # on the final busy-loop snapshot.
                     self._flush_offloads()
                     self._maybe_publish_gauges()
+                    if self.profiler is not None:
+                        # Genuinely idle: wait time must never read as
+                        # host gap on the next dispatch.
+                        self.profiler.mark_idle()
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
@@ -915,12 +974,19 @@ class TPUEngine(AsyncEngine):
                 else:
                     for p in pendings:
                         self._consume_decode(p)
-                if not progressed:
-                    # Pool dry / everything stalled: yield briefly.
+                if progressed:
+                    self._progress_mark += 1
+                else:
+                    # Pool dry / everything stalled: yield briefly. No
+                    # progress bump — this is exactly the state the
+                    # watchdog must see as frozen.
+                    if self.profiler is not None:
+                        self.profiler.mark_idle()
                     self._wake.wait(timeout=0.001)
                     self._wake.clear()
         except Exception:  # engine death must not hang clients
             log.exception("engine loop crashed; failing in-flight requests")
+            self._dump_flight("crash")
             self._running = False
             self._inflight = None
             self._fail_all()
@@ -932,6 +998,15 @@ class TPUEngine(AsyncEngine):
         captured at submission."""
         now = time.time()
         seq.admitted_at = now
+        if self.flight is not None:
+            self.flight.record(
+                "admit",
+                req=seq.request_id,
+                slot=seq.slot,
+                prompt=len(seq.prompt),
+                cached=seq.cached_len,
+                priority=seq.priority,
+            )
         tel = get_telemetry()
         if seq.submitted_at:
             tel.queue_wait.observe(max(now - seq.submitted_at, 0.0))
@@ -942,6 +1017,59 @@ class TPUEngine(AsyncEngine):
                 seq.trace,
                 prompt_tokens=len(seq.prompt),
             )
+
+    # --------------------------------------------------- flight / profiling
+    def _decode_span_attrs(self) -> dict:
+        """Dispatch-profiler attrs for the decode span (scheduler.finish
+        emits it): median in-flight/host-gap per decode window plus the
+        window size, so sim/fit.py can fit per-dispatch service times
+        straight from span files."""
+        if self.profiler is None:
+            return {}
+        return self.profiler.span_attrs(
+            "decode", decode_window=self.cfg.decode_window
+        )
+
+    def _flight_snapshot(self) -> dict:
+        """Best-effort scheduler/slot/page state for a flight dump. May
+        run on the watchdog thread while the loop is wedged — read-only,
+        and a torn read beats no dump."""
+        try:
+            slots = []
+            for i, s in enumerate(self.sched.slots):
+                if s is None:
+                    continue
+                slots.append(
+                    {
+                        "slot": i,
+                        "req": s.request_id,
+                        "state": s.state.value,
+                        "generated": s.generated,
+                        "pages": len(s.page_ids),
+                        "stalled": bool(s.stalled_since),
+                        "preemptions": s.preemptions,
+                    }
+                )
+            return {
+                "slots": slots,
+                "waiting": len(self.sched.waiting),
+                "submitted_unqueued": self._submit_q.qsize(),
+                "pages_active": self.kv.active_pages,
+                "pages_total": self.kv.num_pages,
+                "inflight_window": self._inflight is not None,
+                "progress_mark": self._progress_mark,
+            }
+        except Exception:  # noqa: BLE001 - snapshot is best-effort
+            log.exception("flight snapshot failed")
+            return {}
+
+    def _dump_flight(self, reason: str) -> None:
+        """Dump the flight ring + snapshot (watchdog stall, SIGUSR1 via
+        the process registry, or engine-loop crash)."""
+        if self.flight is None:
+            return
+        path = self.cfg.flight_dump_path or default_dump_path()
+        self.flight.dump(path, reason, snapshot=self._flight_snapshot())
 
     def _maybe_publish_gauges(self) -> None:
         """Mirror engine gauges into the telemetry registry at most
@@ -959,11 +1087,15 @@ class TPUEngine(AsyncEngine):
         while True:
             try:
                 self.kv.confirm_lease(self._lease_confirm_q.get_nowait())
+                if self.flight is not None:
+                    self.flight.record("lease_confirm")
             except queue.Empty:
                 break
         if self.kv.active_leases:
             reclaimed = self.kv.reap_expired()
             if reclaimed:
+                if self.flight is not None:
+                    self.flight.record("lease_reap", pages=reclaimed)
                 get_telemetry().kv_lease_reclaims.inc(reclaimed)
                 log.warning(
                     "reaped %d KV pages from expired handoff leases "
@@ -1067,18 +1199,29 @@ class TPUEngine(AsyncEngine):
                 break
 
     # ----------------------------------------------------- batched page moves
-    def _gather_page_batch(self, pids: list[int]):
+    def _gather_page_batch(self, pids: list[int], kind: str = "kv_move"):
         """ONE compiled multi-page gather: device [L, bucket, ps, HkvD]
         K/V pairs covering ``pids`` (bucket-padded with the last pid; the
         caller slices back to len(pids)). One dispatch per call — a
         3k-ISL extract moves ~190 pages here instead of 190 dispatches
-        and 190 host syncs."""
+        and 190 host syncs. ``kind`` labels the dispatch for the
+        profiler (``kv_move`` for extract, ``offload`` for eviction
+        bursts); the stamp parks in ``_last_move_t`` for whichever
+        existing sync consumes it."""
         bucket = self.cfg.page_move_bucket_for(len(pids))
         padded = np.full(bucket, pids[-1], np.int32)
         padded[: len(pids)] = pids
+        prof = self.profiler
+        if prof is not None:
+            fresh = prof.first_variant("gather", bucket)
+            t0 = prof.begin(kind)
         k_b, v_b = self._gather_pages(
             self.k_cache, self.v_cache, jnp.asarray(padded)
         )
+        if prof is not None:
+            self._last_move_t = prof.end(kind, t0, fresh)
+        if self.flight is not None:
+            self.flight.record("dispatch", dispatch=kind, pages=len(pids))
         self.kv_move_dispatches += 1
         self.kv_page_moves += len(pids)
         return k_b, v_b
@@ -1097,6 +1240,13 @@ class TPUEngine(AsyncEngine):
         pid_arr[: len(pids)] = pids
         hk = np.stack(list(k_pages) + [k_pages[-1]] * pad, axis=1)
         hv = np.stack(list(v_pages) + [v_pages[-1]] * pad, axis=1)
+        prof = self.profiler
+        if prof is not None:
+            # A scatter is never host-synced (dispatch order protects
+            # it), so only the dispatch leg is profiled — adding a sync
+            # here is exactly what the profiler must never do.
+            fresh = prof.first_variant("scatter", bucket)
+            t0 = prof.begin("kv_move")
         self.k_cache, self.v_cache = self._inject_pages(
             self.k_cache,
             self.v_cache,
@@ -1104,6 +1254,12 @@ class TPUEngine(AsyncEngine):
             jnp.asarray(hk),
             jnp.asarray(hv),
         )
+        if prof is not None:
+            prof.end("kv_move", t0, fresh)
+        if self.flight is not None:
+            self.flight.record(
+                "dispatch", dispatch="kv_move", op=op, pages=len(pids)
+            )
         self.kv_move_dispatches += 1
         self.kv_page_moves += len(pids)
         get_telemetry().kv_page_moves.labels(op).inc(len(pids))
@@ -1119,8 +1275,18 @@ class TPUEngine(AsyncEngine):
         moved, self._pending_offloads = self._pending_offloads, []
         if self.copy_stream is None:
             return
-        k_b, v_b = self._gather_page_batch([pid for pid, _ in moved])
-        self.copy_stream.offload_batch([h for _, h in moved], k_b, v_b)
+        k_b, v_b = self._gather_page_batch(
+            [pid for pid, _ in moved], kind="offload"
+        )
+        on_synced = None
+        if self.profiler is not None:
+            # The CopyStream worker's np.asarray is this dispatch's one
+            # host sync; its completion callback is the consume point.
+            prof, t_disp = self.profiler, self._last_move_t
+            on_synced = lambda: prof.consume("offload", t_disp)  # noqa: E731
+        self.copy_stream.offload_batch(
+            [h for _, h in moved], k_b, v_b, on_synced=on_synced
+        )
         get_telemetry().kv_page_moves.labels("offload").inc(len(moved))
 
     # ---------------------------------------------------------------- prefill
@@ -1179,6 +1345,12 @@ class TPUEngine(AsyncEngine):
             cached_tokens=seq.cached_len,
             remote=seq.remote_prefilled or None,
             resumed_tokens=seq.stop.resume_offset or None,
+            # Dispatch-profiler medians (sim/fit.py reads these).
+            **(
+                self.profiler.span_attrs("prefill")
+                if self.profiler is not None
+                else {}
+            ),
         )
         seq.state = SeqState.ACTIVE
         self._counts = self._init_row(self._counts, seq.slot, token)
@@ -1222,8 +1394,18 @@ class TPUEngine(AsyncEngine):
             return [], ""
         k_b, v_b = self._gather_page_batch(pids)
         k_np, v_np = np.asarray(k_b), np.asarray(v_b)  # the one sync
+        if self.profiler is not None:
+            self.profiler.consume("kv_move", self._last_move_t)
+        if self.flight is not None:
+            self.flight.record(
+                "consume", dispatch="kv_move", pages=len(pids)
+            )
         get_telemetry().kv_page_moves.labels("extract").inc(len(pids))
         lease_id = self.kv.grant_lease(pids, self.cfg.kv_lease_ttl_s)
+        if self.flight is not None:
+            self.flight.record(
+                "lease_grant", req=seq.request_id, pages=len(pids)
+            )
         return [
             (
                 np.ascontiguousarray(k_np[:, i]),
@@ -1302,8 +1484,12 @@ class TPUEngine(AsyncEngine):
         want_lp = any(
             self._wants_logprobs(seq) is not None for seq in batch
         )
+        n_variants = len(self._prefill_fns)
         fn = self._prefill_fn(rows, bucket, attn_pages, want_lp)
+        fresh = len(self._prefill_fns) > n_variants
         self._flush_offloads()
+        prof = self.profiler
+        t0 = prof.begin("prefill") if prof is not None else 0.0
         ys, self.k_cache, self.v_cache = fn(
             self.params,
             self.k_cache,
@@ -1317,7 +1503,23 @@ class TPUEngine(AsyncEngine):
             jnp.asarray(top_k),
             jnp.asarray(top_p),
         )
-        return _PendingPrefill(ys=ys, completed=completed, want_lp=want_lp)
+        dispatched_at = (
+            prof.end("prefill", t0, fresh) if prof is not None else 0.0
+        )
+        if self.flight is not None:
+            self.flight.record(
+                "dispatch",
+                dispatch="prefill",
+                rows=len(batch),
+                tokens=int(sum(sizes)),
+                completing=len(completed),
+            )
+        return _PendingPrefill(
+            ys=ys,
+            completed=completed,
+            want_lp=want_lp,
+            dispatched_at=dispatched_at,
+        )
 
     def _consume_prefill(self, pending: _PendingPrefill) -> None:
         """Host sync of a prefill chunk: sample-complete rows emit their
@@ -1330,6 +1532,12 @@ class TPUEngine(AsyncEngine):
             toks, lps, top_ids, top_lps = (np.asarray(y) for y in pending.ys)
         else:
             toks = np.asarray(pending.ys[0])
+        if self.profiler is not None:
+            self.profiler.consume("prefill", pending.dispatched_at)
+        if self.flight is not None:
+            self.flight.record(
+                "consume", dispatch="prefill", completed=len(pending.completed)
+            )
         for i, seq in pending.completed:
             n_top = self._wants_logprobs(seq)
             pack = (
@@ -1423,10 +1631,18 @@ class TPUEngine(AsyncEngine):
                 seq.stalled = True
                 if not seq.stalled_since:
                     seq.stalled_since = time.time()
+                    if self.flight is not None:
+                        self.flight.record(
+                            "stall_start", req=seq.request_id, slot=seq.slot
+                        )
                 continue  # pool dry: this slot idles one window
             seq.stalled = len(seq.page_ids) * ps < min(
                 wpos + K, cfg.max_model_len
             )
+            if seq.stalled_since and self.flight is not None:
+                self.flight.record(
+                    "stall_end", req=seq.request_id, slot=seq.slot
+                )
             seq.stalled_since = 0.0  # progressing (even if window-capped)
             part = sampler if self._needs_sampler(seq) else greedy
             part.append((seq, wpos, cap))
@@ -1526,10 +1742,14 @@ class TPUEngine(AsyncEngine):
         want_lp = any(
             self._wants_logprobs(seq) is not None for seq, _, _ in stepped
         )
+        n_variants = len(self._spec_fns)
         fn = self._spec_fn(
             rows, kb, cfg.page_bucket_for(max_pages), full_sampler, want_lp
         )
+        fresh = len(self._spec_fns) > n_variants
         self._flush_offloads()
+        prof = self.profiler
+        t0 = prof.begin("spec_verify") if prof is not None else 0.0
         if full_sampler:
             ys, self.k_cache, self.v_cache, self._counts = fn(
                 self.params, self.k_cache, self.v_cache,
@@ -1545,6 +1765,13 @@ class TPUEngine(AsyncEngine):
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(table), jnp.asarray(n_drafts),
             )
+        dispatched_at = (
+            prof.end("spec_verify", t0, fresh) if prof is not None else 0.0
+        )
+        if self.flight is not None:
+            self.flight.record(
+                "dispatch", dispatch="spec_verify", rows=len(part), draft_bucket=kb
+            )
         self.steps += T
         self.spec_dispatches += 1
         get_telemetry().decode_batch_rows.observe(len(part))
@@ -1553,6 +1780,7 @@ class TPUEngine(AsyncEngine):
             stepped=stepped,
             full_sampler=full_sampler,
             want_lp=want_lp,
+            dispatched_at=dispatched_at,
         )
 
     def _consume_spec(self, pending: _PendingSpec) -> None:
@@ -1572,6 +1800,12 @@ class TPUEngine(AsyncEngine):
         else:
             targets = np.asarray(pending.ys[0])
             n_emits = np.asarray(pending.ys[1])
+        if self.profiler is not None:
+            self.profiler.consume("spec_verify", pending.dispatched_at)
+        if self.flight is not None:
+            self.flight.record(
+                "consume", dispatch="spec_verify", rows=len(pending.stepped)
+            )
         tel = get_telemetry()
         for seq, g, row in pending.stepped:
             if seq.state is not SeqState.ACTIVE or seq.pending_finish is not None:
@@ -1604,6 +1838,14 @@ class TPUEngine(AsyncEngine):
             tel.spec_draft_tokens.inc(g)
             tel.spec_accepted_tokens.inc(accepted)
             tel.spec_tokens_per_dispatch.observe(len(kept))
+            if self.flight is not None:
+                self.flight.record(
+                    "spec_accept",
+                    req=seq.request_id,
+                    proposed=g,
+                    accepted=accepted,
+                    emitted=len(kept),
+                )
             self._spec.record(seq, proposed=g, accepted=accepted)
             self.sched.register_full_pages(seq)
             n_top = self._wants_logprobs(seq)
@@ -1642,6 +1884,10 @@ class TPUEngine(AsyncEngine):
             extra = seq.page_ids[keep:]
             del seq.page_ids[keep:]
             self.kv.release_sequence(extra)
+            if self.flight is not None:
+                self.flight.record(
+                    "spec_rewind", req=seq.request_id, pages=len(extra)
+                )
 
     def _dispatch_partition(
         self,
@@ -1698,10 +1944,14 @@ class TPUEngine(AsyncEngine):
         want_lp = any(
             self._wants_logprobs(seq) is not None for seq, _, _ in stepped
         )
+        n_variants = len(self._decode_fns)
         fn = self._decode_fn(
             rows, cfg.page_bucket_for(max_pages), full_sampler, want_lp
         )
+        fresh = len(self._decode_fns) > n_variants
         self._flush_offloads()
+        prof = self.profiler
+        t0 = prof.begin("decode") if prof is not None else 0.0
         sampler_args = (seeds, temp, top_k, top_p, freq, pres, rep)
         if full_sampler:
             (ys, self.k_cache, self.v_cache, self._counts,
@@ -1723,6 +1973,13 @@ class TPUEngine(AsyncEngine):
                 jnp.asarray(stop_set), jnp.asarray(eos_gate),
                 jnp.asarray(budget_gate),
             )
+        dispatched_at = (
+            prof.end("decode", t0, fresh) if prof is not None else 0.0
+        )
+        if self.flight is not None:
+            self.flight.record(
+                "dispatch", dispatch="decode", rows=len(part), bucket=rows
+            )
         self.steps += K
         get_telemetry().decode_batch_rows.observe(len(part))
         return _PendingDecode(
@@ -1738,6 +1995,7 @@ class TPUEngine(AsyncEngine):
             stop_tokens=stop_set,
             sampler_args=sampler_args if full_sampler else None,
             slot_map=slot_map if full_sampler else None,
+            dispatched_at=dispatched_at,
         )
 
     def _can_chain(self) -> bool:
@@ -1824,13 +2082,17 @@ class TPUEngine(AsyncEngine):
                 seq, seq.generated + K
             )
             stepped.append((seq, min(K, cap - wpos + 1), r))
+        n_variants = len(self._decode_fns)
         fn = self._decode_fn(
             rows,
             cfg.page_bucket_for(max_pages),
             pending.full_sampler,
             pending.want_lp,
         )
+        fresh = len(self._decode_fns) > n_variants
         self._flush_offloads()
+        prof = self.profiler
+        t0 = prof.begin("decode") if prof is not None else 0.0
         if pending.full_sampler:
             seeds, temp, top_k, top_p, freq, pres, rep = pending.sampler_args
             (ys, self.k_cache, self.v_cache, self._counts,
@@ -1852,6 +2114,17 @@ class TPUEngine(AsyncEngine):
                 jnp.asarray(stop_set), jnp.asarray(eos_gate),
                 jnp.asarray(budget_gate),
             )
+        dispatched_at = (
+            prof.end("decode", t0, fresh) if prof is not None else 0.0
+        )
+        if self.flight is not None:
+            self.flight.record(
+                "dispatch",
+                dispatch="decode",
+                rows=len(stepped),
+                bucket=rows,
+                chained=True,
+            )
         self.steps += K
         get_telemetry().decode_batch_rows.observe(len(stepped))
         return _PendingDecode(
@@ -1867,6 +2140,7 @@ class TPUEngine(AsyncEngine):
             stop_tokens=stop_set,
             sampler_args=pending.sampler_args,
             slot_map=pending.slot_map,
+            dispatched_at=dispatched_at,
         )
 
     def _consume_decode(self, pending: _PendingDecode) -> None:
@@ -1883,9 +2157,13 @@ class TPUEngine(AsyncEngine):
             )
         else:
             sampled = np.asarray(pending.ys[0])
+        if self.profiler is not None:
+            # The np.asarray above was this window's one host sync.
+            self.profiler.consume("decode", pending.dispatched_at)
         tel = get_telemetry()
         finishes: list[Sequence] = []
         wasted = 0
+        emitted = 0
         for seq, n_valid, row in pending.stepped:
             if seq.state is not SeqState.ACTIVE or seq.pending_finish is not None:
                 wasted += n_valid  # whole window past this row's stop
@@ -1901,6 +2179,7 @@ class TPUEngine(AsyncEngine):
                 if reason is not None:
                     break
             wasted += n_valid - len(kept)
+            emitted += len(kept)
             self.sched.register_full_pages(seq)
             n_top = self._wants_logprobs(seq)
             pack = None
@@ -1922,6 +2201,10 @@ class TPUEngine(AsyncEngine):
             if reason is not None:
                 seq.pending_finish = reason
                 finishes.append(seq)
+        if self.flight is not None:
+            self.flight.record(
+                "consume", dispatch="decode", tokens=emitted, wasted=wasted
+            )
         if wasted:
             self.wasted_steps += wasted
             tel.decode_wasted_steps.inc(wasted)
@@ -1952,6 +2235,16 @@ class TPUEngine(AsyncEngine):
         m["kv_lease_reclaimed_pages"] = self.kv.lease_reclaimed_pages
         m["compiled_decode_variants"] = len(self._decode_fns)
         m["compiled_prefill_variants"] = len(self._prefill_fns)
+        # Per-dispatch profiler mirror (docs/observability.md): per-kind
+        # host-gap / in-flight percentiles over the recent window plus
+        # compile attribution — the same numbers the dynamo_dispatch_*
+        # prometheus series aggregate, in pullable form for bench.py's
+        # per-line dispatch field and sim/fit.py's bench fitting.
+        # decode_window rides along so a per-dispatch time converts to a
+        # per-token ITL without a span file.
+        if self.profiler is not None:
+            m["dispatch"] = self.profiler.summary()
+        m["decode_window"] = self.cfg.decode_window
         # Speculative decoding (docs/speculative.md): acceptance rate =
         # accepted/draft, tokens-per-dispatch = emitted/dispatches.
         m["spec_dispatches"] = self.spec_dispatches

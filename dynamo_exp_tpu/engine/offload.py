@@ -128,14 +128,19 @@ class CopyStream:
         self.dropped = 0
         self._thread.start()
 
-    def offload_batch(self, seq_hashes: list, k_dev, v_dev) -> None:
+    def offload_batch(
+        self, seq_hashes: list, k_dev, v_dev, on_synced=None
+    ) -> None:
         """Coalesced offload: one gathered [L, n, ps, HkvD] K/V pair
         covering ``len(seq_hashes)`` pages (page axis 1). The worker
         materializes the whole batch with ONE host transfer and commits
         page-by-page — an eviction burst costs one dispatch + one sync
-        instead of one per page."""
+        instead of one per page. ``on_synced`` (if given) fires right
+        after that existing host transfer completes — the dispatch
+        profiler's consume point for the ``offload`` kind, so in-flight
+        timing rides the sync the stream was doing anyway."""
         try:
-            self._q.put_nowait((list(seq_hashes), k_dev, v_dev))
+            self._q.put_nowait((list(seq_hashes), k_dev, v_dev, on_synced))
         except queue.Full:
             self.dropped += len(seq_hashes)
 
@@ -163,8 +168,13 @@ class CopyStream:
             try:
                 if item is None:
                     return
-                seq_hashes, k_dev, v_dev = item
+                seq_hashes, k_dev, v_dev, on_synced = item
                 k_np, v_np = np.asarray(k_dev), np.asarray(v_dev)
+                if on_synced is not None:
+                    try:
+                        on_synced()
+                    except Exception:  # profiling must not break offload
+                        log.exception("offload on_synced callback failed")
                 for j, h in enumerate(seq_hashes):
                     self.pool.store(h, k_np[:, j], v_np[:, j])
             except Exception:  # never kill the stream on one bad page

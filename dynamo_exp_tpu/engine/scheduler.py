@@ -172,12 +172,19 @@ def select_preemption_victim(candidates, max_preemptions: int):
 
 
 class Scheduler:
-    def __init__(self, cfg: EngineConfig, kv: KvPageManager):
+    def __init__(self, cfg: EngineConfig, kv: KvPageManager, flight=None):
         self.cfg = cfg
         self.kv = kv
         self.waiting: deque[Sequence] = deque()
         self.slots: list[Sequence | None] = [None] * cfg.max_decode_slots
         self.active_count = 0  # PREFILL + ACTIVE (slot holders)
+        # Flight recorder (telemetry/flight.py, engine-owned): finish /
+        # preemption events land in the ring alongside the loop's
+        # dispatch events. None = recording off.
+        self.flight = flight
+        # Set by the engine: () -> dict of dispatch-profiler attrs to
+        # attach to the decode span (sim/fit.py fits from them).
+        self.span_attrs: Callable[[], dict] | None = None
 
     # --------------------------------------------------------------- intake
     def submit(self, seq: Sequence) -> None:
@@ -364,6 +371,15 @@ class Scheduler:
                     if seq.spec_dispatches
                     else None
                 ),
+                **(self.span_attrs() if self.span_attrs is not None else {}),
+            )
+        if self.flight is not None:
+            self.flight.record(
+                "finish",
+                req=seq.request_id,
+                slot=seq.slot if was_bound else None,
+                reason=getattr(reason, "value", str(reason)),
+                generated=seq.generated,
             )
         seq.state = SeqState.FINISHED
         if seq.slot >= 0 and was_bound:
@@ -396,6 +412,14 @@ class Scheduler:
         just parked and starve the stalled rows the preemption was
         meant to feed."""
         k = seq.generated
+        if self.flight is not None:
+            self.flight.record(
+                "preempt",
+                req=seq.request_id,
+                slot=seq.slot,
+                generated=k,
+                freed_pages=len(seq.page_ids),
+            )
         if seq.slot >= 0:
             self.slots[seq.slot] = None
             self.active_count -= 1

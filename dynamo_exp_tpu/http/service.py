@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any
 
 from aiohttp import web
@@ -82,6 +83,7 @@ class HttpService:
         port: int = 8080,
         request_template=None,
         admission: AdmissionController | None = None,
+        slo=None,
     ):
         self.manager = manager or ModelManager()
         self.metrics = metrics or ServiceMetrics()
@@ -94,6 +96,13 @@ class HttpService:
         # shedding (docs/fault_tolerance.md). None = accept unboundedly
         # (embedded/test deployments that bound load elsewhere).
         self.admission = admission
+        # SLO attribution (docs/observability.md "SLO attribution &
+        # goodput"): a telemetry.SloAttribution measuring per-request
+        # TTFT/ITL at this edge against the configured targets — the
+        # same code path the cluster simulator counts SimReport goodput
+        # with, and the window the live SLO planner reads its pressure
+        # inputs from. None = not measured.
+        self.slo = slo
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self._chat)
         self.app.router.add_post("/v1/completions", self._completions)
@@ -221,6 +230,7 @@ class HttpService:
                 aggregate=aggregate,
                 endpoint=endpoint,
                 expand_batch=expand_batch,
+                priority=priority,
             )
         finally:
             # Released only when the response is complete (the SSE stream
@@ -240,7 +250,15 @@ class HttpService:
         aggregate,
         endpoint: str,
         expand_batch,
+        priority: int = 1,
     ) -> web.StreamResponse:
+        # SLO attribution clock: TTFT/ITL are measured from request
+        # arrival at this edge — the latency the client experiences,
+        # which is what the targets are promises about.
+        t_arrival = time.monotonic()
+        # Per-request latency marks filled in by _typed_chunks below
+        # (first/last content chunk, cumulative token watermark).
+        lat = {"first": 0.0, "last": 0.0, "tokens": 0}
         # OpenAI allows a list of prompts in one completion request; fan the
         # batch out as independent sub-requests with re-indexed choices.
         sub_payloads = expand_batch(payload) if expand_batch else [payload]
@@ -331,7 +349,21 @@ class HttpService:
                                 )
                                 last = si
                                 continue
+                            delta = si - high
                             last = high = si
+                        else:
+                            delta = 1
+                        if chunk.choices:
+                            # SLO marks: first/last content chunk and
+                            # cumulative tokens (seq_index watermark
+                            # delta when present, chunk count floor
+                            # otherwise) — the per-request TTFT/ITL fed
+                            # to the edge SLO attribution.
+                            now = time.monotonic()
+                            if not lat["first"]:
+                                lat["first"] = now
+                            lat["last"] = now
+                            lat["tokens"] += max(delta, 1)
                         if idx and chunk.choices:
                             for choice in chunk.choices:
                                 choice.index = idx
@@ -372,6 +404,7 @@ class HttpService:
                     root.set(status="error")
                     ctx.kill()
                     return _error_response(500, str(e))
+                self._record_slo(priority, t_arrival, lat)
                 return web.json_response(full.model_dump(exclude_none=True))
 
             resp = web.StreamResponse(
@@ -386,6 +419,10 @@ class HttpService:
                     frame = Annotated.from_data(chunk.model_dump(exclude_none=True))
                     await resp.write(encode_frame(frame).encode())
                 await resp.write(encode_done().encode())
+                # Attributed only on a fully drained stream: a request
+                # that errored or lost its client is not goodput and
+                # its truncated latencies would poison the window.
+                self._record_slo(priority, t_arrival, lat)
             except (ConnectionResetError, asyncio.CancelledError):
                 # Client went away: kill generation immediately.
                 logger.info("client disconnected; killing request %s", ctx.id)
@@ -402,6 +439,22 @@ class HttpService:
                 await resp.write(encode_frame(err).encode())
             await resp.write_eof()
             return resp
+
+    def _record_slo(self, priority: int, t_arrival: float, lat: dict) -> None:
+        """Feed one completed request into the SLO attribution: TTFT =
+        arrival -> first content chunk, ITL = mean inter-token interval
+        after it (None for single-token responses — never a violation).
+        """
+        if self.slo is None or not lat["first"]:
+            return
+        itl = None
+        if lat["tokens"] > 1:
+            itl = max(lat["last"] - lat["first"], 0.0) / (lat["tokens"] - 1)
+        self.slo.record(
+            priority,
+            ttft_s=max(lat["first"] - t_arrival, 0.0),
+            itl_s=itl,
+        )
 
 
 class _FanoutContext:

@@ -195,6 +195,23 @@ def build_parser() -> argparse.ArgumentParser:
              "(rotated generations are read automatically)",
     )
 
+    # Offline flight-dump rendering (docs/observability.md "Engine
+    # flight recorder & watchdog"): a dump file holds one block per
+    # dump (watchdog stall / SIGUSR1 / crash); render a block as a
+    # per-slot timeline the way `trace` renders spans.
+    flight = sub.add_parser(
+        "flight", help="render an engine flight-recorder dump (offline)"
+    )
+    flight.add_argument("dump_file", help="flight dump JSONL path")
+    flight.add_argument(
+        "--index", type=int, default=-1,
+        help="which dump block to render (default: the last)",
+    )
+    flight.add_argument(
+        "--list", action="store_true",
+        help="list the file's dump blocks instead of rendering one",
+    )
+
     # Offline cluster simulation (docs/simulation.md): replay a seeded
     # workload through the real admission/routing/preemption/planner
     # policy code against modeled instances and print the SimReport.
@@ -270,6 +287,38 @@ def run_trace(args) -> int:
         print(f"no trace matching {args.trace_id!r}", file=sys.stderr)
         return 1
     print(render_timeline(group))
+    return 0
+
+
+def run_flight(args) -> int:
+    import os
+
+    from .telemetry import load_dumps, render_flight
+
+    if not os.path.exists(args.dump_file):
+        print(f"no such dump file: {args.dump_file}", file=sys.stderr)
+        return 2
+    blocks = load_dumps(args.dump_file)
+    if not blocks:
+        print("no flight dumps in file", file=sys.stderr)
+        return 1
+    if args.list:
+        for i, b in enumerate(blocks):
+            h = b["header"]
+            print(
+                f"{i}  reason={h.get('reason', '?')}  "
+                f"{len(b['events'])} events  pid={h.get('pid', '?')}"
+            )
+        return 0
+    try:
+        block = blocks[args.index]
+    except IndexError:
+        print(
+            f"dump index {args.index} out of range ({len(blocks)} blocks)",
+            file=sys.stderr,
+        )
+        return 1
+    print(render_flight(block))
     return 0
 
 
@@ -396,6 +445,8 @@ async def run(args) -> int:
 
     if args.plane == "trace":  # offline: reads recorder files, no cluster
         return run_trace(args)
+    if args.plane == "flight":  # offline: reads flight dumps, no cluster
+        return run_flight(args)
     if args.plane == "sim":  # offline: modeled fleet, no cluster
         return run_sim(args)
     if not args.coordinator:

@@ -88,6 +88,7 @@ class Planner:
         config: PlannerConfig,
         connector=None,
         clock: Callable[[], float] = time.monotonic,
+        slo_source=None,
     ):
         from ..kv_router.metrics_aggregator import KvMetricsAggregator
         from .connector import LocalConnector
@@ -116,6 +117,13 @@ class Planner:
         # (cleared with the interval: absent means no signal).
         self.ttft_p99_s: float | None = None
         self.itl_p99_s: float | None = None
+        # SLO attribution source (telemetry.SloAttribution, usually the
+        # HTTP edge's): each adjustment round pulls its p99 pressure
+        # inputs from the attribution window and resets it — so
+        # plan_step_slo is driven by the SAME measurements the goodput/
+        # violation counters export, live and in the simulator
+        # (docs/observability.md "SLO attribution & goodput").
+        self.slo_source = slo_source
         self.adjustments: list[dict] = []  # decision log (tests/observability)
         self._stop = asyncio.Event()
 
@@ -227,6 +235,14 @@ class Planner:
         in planner/policy.py — shared verbatim with the cluster
         simulator."""
         cfg = self.cfg
+        if self.slo_source is not None:
+            # Pressure inputs from the shared attribution window; the
+            # window resets with the interval exactly like the KV/queue
+            # samples (stale breaches must not read as pressure).
+            self.ttft_p99_s, self.itl_p99_s = (
+                self.slo_source.window_percentiles()
+            )
+            self.slo_source.reset_window()
         obs = self.observe(p_endpoints, d_endpoints)
         if cfg.slo is not None:
             decision, self._plan_state = plan_step_slo(

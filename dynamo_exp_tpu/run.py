@@ -94,6 +94,17 @@ def parse_args(argv: list[str]):
     p.add_argument("--shed-watermark", type=int, default=0,
                    help="in-flight level where low-priority requests start "
                         "shedding with 429 (default: 3/4 of --max-inflight)")
+    # SLO attribution (docs/observability.md "SLO attribution &
+    # goodput"): per-request TTFT/ITL measured at the HTTP edge against
+    # these targets -> dynamo_slo_violations_total{slo,priority} /
+    # dynamo_goodput_requests_total{priority}; the SLO planner's
+    # pressure inputs read the same window.
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="time-to-first-token SLO target in ms (0 = not "
+                        "an SLO; still unmeasured unless --slo-itl-ms set)")
+    p.add_argument("--slo-itl-ms", type=float, default=0.0,
+                   help="inter-token-latency SLO target in ms (0 = not "
+                        "an SLO)")
     p.add_argument("--profiler-port", type=int, default=0,
                    help="expose the jax.profiler gRPC server on this port "
                         "(attach with tensorboard/xprof); 0 = off")
@@ -318,11 +329,23 @@ async def run_http(opts, drt, core, full, mdc):
             max_inflight=opts.max_inflight,
             shed_watermark=opts.shed_watermark or None,
         )
+    slo = None
+    if opts.slo_ttft_ms > 0 or opts.slo_itl_ms > 0:
+        from .telemetry import SloAttribution, SloConfig, get_telemetry
+
+        slo = SloAttribution(
+            SloConfig(
+                ttft_s=opts.slo_ttft_ms / 1e3 if opts.slo_ttft_ms > 0 else None,
+                itl_s=opts.slo_itl_ms / 1e3 if opts.slo_itl_ms > 0 else None,
+            ),
+            get_telemetry(),
+        )
     svc = HttpService(
         host=opts.http_host,
         port=opts.http_port,
         request_template=template,
         admission=admission,
+        slo=slo,
     )
     watcher = None
     kv_router = None
@@ -677,9 +700,19 @@ def main(argv: list[str] | None = None) -> None:
 
     def _dump_tasks(*_):
         """SIGUSR1: print every pending task's stack — the first tool to
-        reach for when a node wedges during drain."""
+        reach for when a node wedges during drain — and dump every live
+        engine's flight recorder ring (telemetry/flight.py; render with
+        ``llmctl flight <file>``)."""
         import faulthandler
 
+        from .telemetry import dump_all
+
+        n = dump_all("sigusr1")
+        if n:
+            print(
+                f"==== SIGUSR1 flight dump ({n} engine(s)) ====",
+                file=sys.stderr, flush=True,
+            )
         print("==== SIGUSR1 task dump ====", file=sys.stderr, flush=True)
         for t in asyncio.all_tasks(loop):
             print(f"-- {t.get_name()}: {t.get_coro()}", file=sys.stderr)

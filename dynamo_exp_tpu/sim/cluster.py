@@ -54,6 +54,7 @@ from ..planner.policy import (
     plan_step,
     plan_step_slo,
 )
+from ..telemetry.slo import SloAttribution, SloConfig
 from .core import EventLoop
 from .fit import ServiceTimeModel
 from .report import SimReport, percentile
@@ -207,13 +208,23 @@ class ClusterSim:
         self.report = SimReport()
         self._ttfts: list[float] = []
         self._itls: list[float] = []
-        # Per-adjustment-interval planner sample windows.
+        # Per-adjustment-interval planner sample window (KV only; the
+        # latency window lives in the shared SLO attribution below).
         self._kv_samples: list[float] = []
-        self._win_ttfts: list[float] = []
-        self._win_itls: list[float] = []
         self._plan_state = PlannerState()
         self._pcfg = cfg.planner_cfg or PlannerConfig()
         self._slo = cfg.slo or SloTargets()
+        # Shared SLO/goodput attribution (telemetry/slo.py): the very
+        # class the live HTTP edge feeds and the live planner reads —
+        # the sim's SimReport goodput/violation counts and its
+        # plan_step_slo pressure window go through it verbatim, closing
+        # the live<->sim calibration loop (docs/observability.md).
+        self.slo_attr = SloAttribution(
+            SloConfig(
+                ttft_s=self._slo.ttft_p99_slo_s or None,
+                itl_s=self._slo.itl_p99_slo_s or None,
+            )
+        )
         self._chip_seconds = 0.0
         self._chips_since = 0.0
         self.event_log: list[str] = []
@@ -410,7 +421,7 @@ class ClusterSim:
             seq.first_token_at = self.loop.now
             ttft = self.loop.now - seq.req.arrival_s
             self._ttfts.append(ttft)
-            self._win_ttfts.append(ttft)
+            self.slo_attr.observe_ttft(ttft)
         rows = sum(1 for s in inst.bound if s.state is SeqState.ACTIVE)
         seq.itl = cfg.service.decode_itl(
             rows, cfg.slots_per_instance, self.rng_service
@@ -592,12 +603,22 @@ class ClusterSim:
             self.report.completed_tokens += seq.delivered
             if seq.cap_hit:
                 self.report.capacity_capped += 1
+            itl = None
             if seq.delivered > 1 and seq.first_token_at:
                 itl = (self.loop.now - seq.first_token_at) / (
                     seq.delivered - 1
                 )
                 self._itls.append(itl)
-                self._win_itls.append(itl)
+                self.slo_attr.observe_itl(itl)
+            # Shared-path attribution: same call the live edge makes
+            # per drained stream (shed/errored work is never fed here,
+            # so it can't count as goodput — matching the edge).
+            ttft = (
+                seq.first_token_at - seq.req.arrival_s
+                if seq.first_token_at
+                else None
+            )
+            self.slo_attr.count(seq.priority, ttft_s=ttft, itl_s=itl)
         else:
             self.report.errors += 1
         self._log("req %d finished %s (%d tok)", seq.req.index, reason, seq.delivered)
@@ -638,13 +659,17 @@ class ClusterSim:
             )
 
     def _on_adjust_tick(self) -> None:
+        # Pressure inputs from the shared attribution window — the same
+        # window_percentiles()/reset_window() round the live Planner
+        # makes against the HTTP edge's attribution.
+        ttft_p99, itl_p99 = self.slo_attr.window_percentiles()
         obs = PlannerObservation(
             num_prefill=0,
             num_decode=len(self.instances) + self._provisioning,
             prefill_queue=(),
             kv_load=tuple(self._kv_samples),
-            ttft_p99_s=percentile(self._win_ttfts, 0.99),
-            itl_p99_s=percentile(self._win_itls, 0.99),
+            ttft_p99_s=ttft_p99,
+            itl_p99_s=itl_p99,
             now=self.loop.now,
         )
         if self.cfg.planner == "slo":
@@ -675,8 +700,7 @@ class ClusterSim:
                     if inst.idle and len(self.instances) > 1:
                         self._retire(inst)
         self._kv_samples = []
-        self._win_ttfts = []
-        self._win_itls = []
+        self.slo_attr.reset_window()
         if self._fleet_busy():
             self.loop.after(
                 self._pcfg.adjustment_interval, self._on_adjust_tick
@@ -707,6 +731,12 @@ class ClusterSim:
         r.chip_seconds = round(self._chip_seconds, 3)
         if r.duration_s > 0:
             r.goodput_tok_s = round(r.completed_tokens / r.duration_s, 3)
+        # SLO attribution totals (shared telemetry/slo.py code path —
+        # the live edge's dynamo_goodput_requests_total /
+        # dynamo_slo_violations_total equivalents).
+        r.goodput_requests = self.slo_attr.goodput_total
+        r.slo_violations_ttft = self.slo_attr.violations["ttft"]
+        r.slo_violations_itl = self.slo_attr.violations["itl"]
         r.ttft_p50_s = percentile(self._ttfts, 0.5)
         r.ttft_p99_s = percentile(self._ttfts, 0.99)
         r.itl_p50_s = percentile(self._itls, 0.5)

@@ -12,7 +12,12 @@ actually produced:
 - **BENCH JSON** (``bench.py`` output, or the driver's ``BENCH_r*.json``
   wrappers with a ``parsed`` record): ``decode_throughput_*_c{N}``
   lines give aggregate tok/s at concurrency N → per-row ITL = N/tok_s;
-  ``p50_ttft_s`` over the metric's ISL gives prefill per token.
+  ``p50_ttft_s`` over the metric's ISL gives prefill per token. Lines
+  without a concurrency-tagged throughput metric fall back to their
+  per-kind ``dispatch`` percentiles (every bench line carries them):
+  decode (in-flight + host-gap) p50 over ``decode_window`` tokens.
+  Decode spans carrying dispatch-profiler attrs contribute the same
+  per-window samples directly.
 
 Latencies are modeled lognormal (service times are multiplicative:
 right-skewed, never negative) around the fitted median; draws come from
@@ -212,7 +217,25 @@ def _span_samples(
                 toks = int(attrs.get("generated_tokens") or 0)
                 spec = attrs.get("spec_tokens_per_dispatch")
                 spec_on = isinstance(spec, (int, float)) and spec > 0
-                if toks > 1:
+                # Dispatch-profiler attrs (docs/observability.md): the
+                # engine's median per-window (dispatch + host gap) time
+                # over decode_window tokens is a direct per-token ITL
+                # sample — unlike the wall duration it excludes queue
+                # wait and stalls. It REPLACES the duration-derived
+                # sample for spans that carry it (adding both would
+                # blend two populations and let the repeated engine-wide
+                # median swamp the fit).
+                dp = attrs.get("dispatch_p50_s")
+                win = attrs.get("decode_window")
+                if (
+                    isinstance(dp, (int, float))
+                    and dp > 0
+                    and isinstance(win, (int, float))
+                    and win >= 1
+                ):
+                    gap = attrs.get("host_gap_p50_s") or 0.0
+                    itl.append((float(dp) + float(gap)) / float(win))
+                elif toks > 1:
                     # Normalize to the per-DISPATCH interval: a spec-on
                     # span's per-token ITL already embeds the multi-
                     # token speedup, and decode_itl() divides by the
@@ -264,10 +287,28 @@ def _bench_samples(
                 r"_a(\d+)of\d+$", metric
             )
             conc = int(m.group(1)) if m else None
-            if metric.startswith(
+            throughput_line = metric.startswith(
                 ("decode_throughput", "decode_occupancy")
-            ) and conc:
+            ) and bool(conc)
+            if throughput_line:
                 itl.append(conc / float(value))
+            else:
+                # Per-kind dispatch percentiles (bench.py attaches them
+                # to every line): (in-flight + host-gap) p50 over the
+                # line's decode_window is a per-token ITL sample — the
+                # fallback that fits service times from lines with no
+                # concurrency-tagged throughput metric.
+                disp = (rec.get("dispatch") or {}).get("decode") or {}
+                flight = disp.get("in_flight_p50_s")
+                win = rec.get("decode_window")
+                if (
+                    isinstance(flight, (int, float))
+                    and flight > 0
+                    and isinstance(win, (int, float))
+                    and win >= 1
+                ):
+                    gap = disp.get("host_gap_p50_s") or 0.0
+                    itl.append((float(flight) + float(gap)) / float(win))
             ttft = rec.get("p50_ttft_s")
             isl_m = re.search(r"_isl(\d+)", metric)
             if (

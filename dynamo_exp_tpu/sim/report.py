@@ -3,21 +3,13 @@
 from __future__ import annotations
 
 import json
-import math
 from dataclasses import dataclass, field
 
-
-def percentile(samples: list[float], q: float) -> float | None:
-    """Nearest-rank percentile: ``sorted[ceil(q*n) - 1]``. On a 2-sample
-    window p99 is the MAX, not the min — these window percentiles feed
-    the SLO planner's pressure terms, and flooring the rank would hide
-    a breached tail exactly in low-throughput windows. None on no
-    samples."""
-    if not samples:
-        return None
-    s = sorted(samples)
-    rank = min(max(math.ceil(q * len(s)), 1), len(s))
-    return s[rank - 1]
+# Canonical nearest-rank percentile now lives with the shared SLO
+# attribution (telemetry/slo.py) so the sim report, the live planner's
+# pressure inputs, and the dispatch-profiler summaries all agree on one
+# definition; re-exported here for existing importers.
+from ..telemetry.slo import percentile  # noqa: F401
 
 
 @dataclass
@@ -45,6 +37,13 @@ class SimReport:
     capacity_capped: int = 0
     completed_tokens: int = 0
     goodput_tok_s: float = 0.0
+    # SLO attribution (telemetry/slo.py SloAttribution — the same code
+    # path the live edge exports as dynamo_goodput_requests_total /
+    # dynamo_slo_violations_total): completed requests meeting every
+    # configured target, and per-target breach counts.
+    goodput_requests: int = 0
+    slo_violations_ttft: int = 0
+    slo_violations_itl: int = 0
     # Tokens delivered per decode dispatch under the fitted speculative
     # decoding factor (1.0 = speculation off): `llmctl sim` runs fitted
     # from spec-tagged telemetry report it so spec-on fleet studies are

@@ -18,24 +18,43 @@ from .context import (
     new_trace,
     wire_headers,
 )
+from .dispatch import DISPATCH_KINDS, DispatchProfiler
+from .flight import (
+    FlightRecorder,
+    Watchdog,
+    dump_all,
+    load_dumps,
+    render_flight,
+)
+from .slo import SloAttribution, SloConfig, percentile
 from .spans import Span, Telemetry, adopt, get_telemetry, span
 from .timeline import find_trace, list_traces, load_spans, render_timeline
 
 __all__ = [
-    "TraceContext",
+    "DISPATCH_KINDS",
+    "DispatchProfiler",
+    "FlightRecorder",
+    "SloAttribution",
+    "SloConfig",
     "Span",
     "Telemetry",
+    "TraceContext",
+    "Watchdog",
     "adopt",
     "attach",
     "current_span_id",
     "current_trace",
     "current_trace_id",
     "detach",
+    "dump_all",
     "find_trace",
     "get_telemetry",
     "list_traces",
+    "load_dumps",
     "load_spans",
     "new_trace",
+    "percentile",
+    "render_flight",
     "render_timeline",
     "span",
     "wire_headers",

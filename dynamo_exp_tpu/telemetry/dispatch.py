@@ -1,0 +1,197 @@
+"""Per-dispatch device profiling (docs/observability.md "Per-dispatch
+device profiling").
+
+The engine loop issues five kinds of device dispatch — prefill chunks,
+decode windows, speculative verify passes, KV page gather/scatter
+moves, and eviction offload batches — and in the overlapped steady
+state (docs/engine_perf.md) a throughput problem is always one of two
+things: the device spent too long *in flight*, or the host left a *gap*
+between consuming one dispatch and issuing the next. This profiler
+attributes wall time to exactly those two buckets per dispatch kind,
+plus compiled-variant cache behavior, using nothing but
+``time.monotonic()`` timestamps taken at call sites the engine already
+owns:
+
+- ``begin(kind)`` immediately before the dispatch call records the
+  **host gap** since the kind's previous consume (or previous dispatch,
+  for kinds that are never host-synced) and returns the timestamp;
+- ``end(kind, t0, fresh)`` right after the dispatch call returns stamps
+  dispatch completion (and, for a fresh compiled variant, attributes
+  the call's wall time — trace + compile + program load — to
+  ``dynamo_compile_seconds{kind}``);
+- ``consume(kind, t_dispatch)`` right after the *already-present* host
+  sync (the ``np.asarray`` the engine was going to do anyway) records
+  the **in-flight** time.
+
+Nothing here blocks, syncs, or touches the device: the overhead
+guarantee is *zero additional host syncs per dispatch* (asserted by the
+sync-spy smoke test in tests/test_dispatch_profile.py), and the overlap
+semantics the recompile-guard / chained-decode identity suites police
+are untouched. In the chained steady state the host gap collapses
+toward zero — which is precisely the signal: a growing gap under
+overlap means the host loop, not the device, is the bottleneck (APEX,
+arxiv 2506.03296).
+
+Samples live in bounded per-kind reservoirs (recent-window deques), so
+``summary()`` percentiles are cheap and memory is O(1); lifetime totals
+ride the prometheus histograms.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from .slo import percentile
+
+# The engine's five device-dispatch kinds. Stable, closed set: the
+# prometheus label space, the metrics() mirror, and bench.py's per-kind
+# percentiles all key on these names.
+DISPATCH_KINDS = ("prefill", "decode", "spec_verify", "kv_move", "offload")
+
+# Summary stat fields (also the bench JSON / docs contract).
+SUMMARY_FIELDS = (
+    "count",
+    "host_gap_p50_s",
+    "host_gap_p99_s",
+    "in_flight_p50_s",
+    "in_flight_p99_s",
+    "compile_misses",
+    "compile_total_s",
+)
+
+
+class DispatchProfiler:
+    """Host-side per-dispatch timing. All methods are cheap (two clock
+    reads and a deque append at worst) and rely on the GIL for the
+    cross-thread case (the offload consume arrives from the CopyStream
+    thread; ``summary()`` may be called from any thread)."""
+
+    def __init__(self, telemetry=None, reservoir: int = 1024):
+        self._tel = telemetry
+        self._gap = {k: deque(maxlen=reservoir) for k in DISPATCH_KINDS}
+        self._flight = {k: deque(maxlen=reservoir) for k in DISPATCH_KINDS}
+        self._count = dict.fromkeys(DISPATCH_KINDS, 0)
+        self._compile_misses = dict.fromkeys(DISPATCH_KINDS, 0)
+        self._compile_s = dict.fromkeys(DISPATCH_KINDS, 0.0)
+        # kind -> (monotonic time, event seq) of the last consume (or
+        # dispatch end, for never-synced kinds); cleared on idle so
+        # gaps never span a genuinely-idle engine. The event seq gates
+        # gap recording: if ANY other profiler event landed in between,
+        # the engine was busy with other dispatch kinds and the elapsed
+        # time is work inter-arrival, not host overhead — a prefill
+        # arriving 5s into a decode-saturated steady state must not
+        # read as a 5s prefill host gap.
+        self._last_consume: dict[str, tuple[float, int]] = {}
+        self._event_seq = 0
+        # (family, key) variants already seen — freshness for compiled
+        # caches that live inside a single jax.jit (the page-move
+        # kernels key variants by bucket shape, invisibly to the
+        # engine-level fn caches).
+        self._seen_variants: set = set()
+
+    # ------------------------------------------------------------ dispatch
+    def begin(self, kind: str) -> float:
+        """Immediately before the dispatch call; returns its timestamp
+        (pass to :meth:`end` and stash for :meth:`consume`). The host
+        gap since the kind's previous consume is recorded only when no
+        other dispatch activity intervened — back-to-back work of the
+        same kind, the case where the elapsed time really is host
+        overhead."""
+        now = time.monotonic()
+        last = self._last_consume.get(kind)
+        self._event_seq += 1
+        if last is not None and last[1] == self._event_seq - 1:
+            gap = max(now - last[0], 0.0)
+            self._gap[kind].append(gap)
+            if self._tel is not None:
+                self._tel.host_gap_seconds.labels(kind).observe(gap)
+        return now
+
+    def end(self, kind: str, t0: float, fresh: bool = False) -> float:
+        """Immediately after the dispatch call returns. ``fresh`` marks
+        a compiled-variant cache miss: the call's wall time is the
+        first-compile duration (jit traces/compiles synchronously inside
+        the call; steady-state calls only enqueue). Returns the
+        dispatch-completion timestamp for :meth:`consume`."""
+        now = time.monotonic()
+        self._count[kind] += 1
+        if fresh:
+            dur = max(now - t0, 0.0)
+            self._compile_misses[kind] += 1
+            self._compile_s[kind] += dur
+            if self._tel is not None:
+                self._tel.compile_cache_misses.labels(kind).inc()
+                self._tel.compile_seconds.labels(kind).observe(dur)
+        # Never-synced kinds (scatter moves) get their gap reference
+        # here; synced kinds overwrite it with the later consume.
+        self._event_seq += 1
+        self._last_consume[kind] = (now, self._event_seq)
+        return now
+
+    def first_variant(self, family: str, key) -> bool:
+        """True exactly once per (family, key): compile-miss detection
+        for variant caches the engine can't watch by size (jit-internal
+        shape keys)."""
+        k = (family, key)
+        if k in self._seen_variants:
+            return False
+        self._seen_variants.add(k)
+        return True
+
+    # ------------------------------------------------------------- consume
+    def consume(self, kind: str, t_dispatch: float) -> None:
+        """Immediately after the dispatch's existing host sync. Records
+        in-flight time and arms the kind's host-gap reference."""
+        now = time.monotonic()
+        if t_dispatch > 0.0:
+            flight = max(now - t_dispatch, 0.0)
+            self._flight[kind].append(flight)
+            if self._tel is not None:
+                self._tel.dispatch_seconds.labels(kind).observe(flight)
+        self._event_seq += 1
+        self._last_consume[kind] = (now, self._event_seq)
+
+    def mark_idle(self) -> None:
+        """The loop is parking (no work, or everything stalled): drop
+        the gap references so wait time never reads as host gap."""
+        self._last_consume.clear()
+
+    # ------------------------------------------------------------- summary
+    @staticmethod
+    def _p(samples, q) -> float | None:
+        v = percentile(list(samples), q)
+        return round(v, 6) if v is not None else None
+
+    def summary(self) -> dict:
+        """Per-kind stats over the recent reservoir window — the
+        ``engine.metrics()["dispatch"]`` mirror and bench.py's per-line
+        dispatch field. Every kind is always present (count 0, None
+        percentiles before its first dispatch) so consumers see a
+        stable shape."""
+        out = {}
+        for k in DISPATCH_KINDS:
+            out[k] = {
+                "count": self._count[k],
+                "host_gap_p50_s": self._p(self._gap[k], 0.5),
+                "host_gap_p99_s": self._p(self._gap[k], 0.99),
+                "in_flight_p50_s": self._p(self._flight[k], 0.5),
+                "in_flight_p99_s": self._p(self._flight[k], 0.99),
+                "compile_misses": self._compile_misses[k],
+                "compile_total_s": round(self._compile_s[k], 6),
+            }
+        return out
+
+    def span_attrs(self, kind: str, **extra) -> dict:
+        """Attrs for the existing decode/prefill spans (sim/fit.py fits
+        per-dispatch service times from these): median in-flight and
+        host-gap for the kind, or {} before the first sample."""
+        flight = self._p(self._flight[kind], 0.5)
+        if flight is None:
+            return {}
+        gap = self._p(self._gap[kind], 0.5)
+        return {
+            "dispatch_p50_s": flight,
+            "host_gap_p50_s": gap if gap is not None else 0.0,
+            **extra,
+        }

@@ -1,0 +1,194 @@
+"""Shared SLO / goodput attribution (docs/observability.md "SLO
+attribution & goodput").
+
+One request either met its latency targets or it didn't — and the
+answer must be computed by exactly one piece of code, wherever the
+question is asked:
+
+- the **live HTTP edge** measures per-request TTFT/ITL as the stream
+  drains and feeds them here (prometheus counters
+  ``dynamo_slo_violations_total{slo,priority}`` /
+  ``dynamo_goodput_requests_total{priority}``);
+- the **live planner** reads its ``plan_step_slo`` p99 pressure inputs
+  from this window (``window_percentiles``), not from a separate
+  histogram pipeline;
+- the **cluster simulator** counts ``SimReport`` goodput/violations and
+  derives its planner pressure through the very same class — so a
+  policy tuned in simulation is judged by the counter the live fleet
+  will export (the calibration loop docs/simulation.md describes).
+
+``percentile`` lives here (nearest-rank, p99-of-2-samples-is-the-max)
+and is re-exported by ``sim/report.py`` — one percentile definition for
+the report, the pressure inputs, and the dispatch-profiler summaries.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+# Admission priority classes (http/admission.py) -> counter label names.
+PRIORITY_NAMES = {0: "low", 1: "normal", 2: "high"}
+
+
+def percentile(samples: list[float], q: float) -> float | None:
+    """Nearest-rank percentile: ``sorted[ceil(q*n) - 1]``. On a 2-sample
+    window p99 is the MAX, not the min — these window percentiles feed
+    the SLO planner's pressure terms, and flooring the rank would hide
+    a breached tail exactly in low-throughput windows. None on no
+    samples."""
+    if not samples:
+        return None
+    s = sorted(samples)
+    rank = min(max(math.ceil(q * len(s)), 1), len(s))
+    return s[rank - 1]
+
+
+@dataclass(frozen=True)
+class SloConfig:
+    """Per-request latency targets. ``None`` means the axis is not an
+    SLO (it is still measured for the pressure window, never counted as
+    a violation)."""
+
+    ttft_s: float | None = None
+    itl_s: float | None = None
+
+    @property
+    def active(self) -> bool:
+        return self.ttft_s is not None or self.itl_s is not None
+
+
+class SloAttribution:
+    """Windowed TTFT/ITL attribution against :class:`SloConfig` targets.
+
+    Thread-safe (the HTTP edge records from request tasks while the
+    planner reads the window). Two surfaces:
+
+    - ``observe_ttft`` / ``observe_itl`` feed the *pressure window*
+      (``window_percentiles`` → ``reset_window`` per adjustment
+      interval);
+    - ``count`` attributes one *completed* request: each breached
+      target increments its violation counter, a request breaching
+      nothing counts as goodput. Shed/errored requests are never fed
+      here — they have their own counters and contribute no goodput by
+      construction.
+
+    ``record`` composes both for call sites (the live edge) that learn
+    TTFT and ITL at the same moment; the simulator calls the pieces at
+    the instants its event loop learns them.
+    """
+
+    def __init__(
+        self,
+        cfg: SloConfig | None = None,
+        telemetry=None,
+        window: int = 4096,
+    ):
+        self.cfg = cfg or SloConfig()
+        self._tel = telemetry
+        self._lock = threading.Lock()
+        self._window = window
+        self.completed = 0
+        self.violations: dict[str, int] = {"ttft": 0, "itl": 0}
+        self.goodput_by_priority: dict[str, int] = {}
+        # Bounded: a deployment with SLO flags but no planner pulling
+        # (and resetting) the window must not leak two floats per
+        # request forever — the window self-truncates to the most
+        # recent ``window`` samples, which is also the right percentile
+        # basis when nobody resets it.
+        self._win_ttft: deque[float] = deque(maxlen=window)
+        self._win_itl: deque[float] = deque(maxlen=window)
+
+    # ------------------------------------------------------ pressure window
+    def observe_ttft(self, ttft_s: float) -> None:
+        with self._lock:
+            self._win_ttft.append(ttft_s)
+
+    def observe_itl(self, itl_s: float) -> None:
+        with self._lock:
+            self._win_itl.append(itl_s)
+
+    def window_percentiles(self) -> tuple[float | None, float | None]:
+        """(p99 TTFT, p99 ITL) over the current window — the exact
+        ``PlannerObservation.ttft_p99_s`` / ``itl_p99_s`` pressure
+        inputs ``plan_step_slo`` consumes, live and simulated."""
+        with self._lock:
+            return (
+                percentile(list(self._win_ttft), 0.99),
+                percentile(list(self._win_itl), 0.99),
+            )
+
+    def reset_window(self) -> None:
+        """Clear the pressure window (one call per adjustment interval;
+        mirrors the live planner's stale-sample discipline)."""
+        with self._lock:
+            self._win_ttft = deque(maxlen=self._window)
+            self._win_itl = deque(maxlen=self._window)
+
+    # -------------------------------------------------------- attribution
+    @staticmethod
+    def priority_name(priority) -> str:
+        if isinstance(priority, str):
+            return priority
+        return PRIORITY_NAMES.get(priority, str(priority))
+
+    def count(
+        self,
+        priority,
+        ttft_s: float | None = None,
+        itl_s: float | None = None,
+    ) -> tuple[str, ...]:
+        """Attribute one completed request; returns the breached SLOs
+        (``()`` = goodput). A target left ``None`` in the config — or a
+        latency the caller couldn't measure (e.g. ITL of a 1-token
+        response) — never counts as a violation."""
+        violated = []
+        if (
+            self.cfg.ttft_s is not None
+            and ttft_s is not None
+            and ttft_s > self.cfg.ttft_s
+        ):
+            violated.append("ttft")
+        if (
+            self.cfg.itl_s is not None
+            and itl_s is not None
+            and itl_s > self.cfg.itl_s
+        ):
+            violated.append("itl")
+        name = self.priority_name(priority)
+        with self._lock:
+            self.completed += 1
+            for v in violated:
+                self.violations[v] += 1
+            if not violated:
+                self.goodput_by_priority[name] = (
+                    self.goodput_by_priority.get(name, 0) + 1
+                )
+        if self._tel is not None:
+            for v in violated:
+                self._tel.slo_violations.labels(v, name).inc()
+            if not violated:
+                self._tel.goodput_requests.labels(name).inc()
+        return tuple(violated)
+
+    def record(
+        self,
+        priority,
+        ttft_s: float | None = None,
+        itl_s: float | None = None,
+    ) -> tuple[str, ...]:
+        """Observe into the pressure window AND attribute, in one call
+        (the live edge learns both at stream end)."""
+        if ttft_s is not None:
+            self.observe_ttft(ttft_s)
+        if itl_s is not None:
+            self.observe_itl(itl_s)
+        return self.count(priority, ttft_s=ttft_s, itl_s=itl_s)
+
+    # ------------------------------------------------------------- totals
+    @property
+    def goodput_total(self) -> int:
+        with self._lock:
+            return sum(self.goodput_by_priority.values())

@@ -19,6 +19,7 @@ contextvar it doesn't share.
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import logging
 import os
@@ -45,6 +46,12 @@ _TBT_BUCKETS = (
 _BYTES_BUCKETS = (
     1 << 10, 16 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20,
     64 << 20, 256 << 20, 1 << 30,
+)
+# Per-dispatch timings: a decode window is ms-scale, a cold first
+# compile can be tens of seconds — one bucket set spans both tails.
+_DISPATCH_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
 
 # Engine gauges: metrics()-dict key -> (prometheus name, help).
@@ -269,10 +276,74 @@ class Telemetry:
             buckets=(1, 2, 3, 4, 6, 8, 12, 16),
             registry=self.registry,
         )
+        # Per-dispatch device profiling (docs/observability.md
+        # "Per-dispatch device profiling"): every device dispatch —
+        # prefill chunk, decode window, spec verify, KV gather/scatter
+        # move, eviction offload batch — split into in-flight time
+        # (dispatch -> existing host sync) and the host gap before it
+        # (previous consume -> next dispatch), plus compiled-variant
+        # cache behavior. Fed by telemetry.dispatch.DispatchProfiler
+        # from the engine loop's existing timestamps: no added syncs.
+        self.dispatch_seconds = Histogram(
+            "dynamo_dispatch_seconds",
+            "Device dispatch in-flight time (dispatch to the existing "
+            "host sync), by dispatch kind",
+            ["kind"],  # prefill | decode | spec_verify | kv_move | offload
+            buckets=_DISPATCH_BUCKETS,
+            registry=self.registry,
+        )
+        self.host_gap_seconds = Histogram(
+            "dynamo_host_gap_seconds",
+            "Host gap between consuming a dispatch and issuing the "
+            "kind's next one (~0 in the overlapped steady state)",
+            ["kind"],
+            buckets=_DISPATCH_BUCKETS,
+            registry=self.registry,
+        )
+        self.compile_seconds = Histogram(
+            "dynamo_compile_seconds",
+            "First-call duration of a fresh compiled variant "
+            "(trace + compile + program load), by dispatch kind",
+            ["kind"],
+            buckets=_STAGE_BUCKETS,
+            registry=self.registry,
+        )
+        self.compile_cache_misses = Counter(
+            "dynamo_compile_cache_misses_total",
+            "Compiled-variant cache misses, by dispatch kind (steady "
+            "state should stop incrementing — see the recompile guard)",
+            ["kind"],
+            registry=self.registry,
+        )
+        # SLO/goodput attribution (docs/observability.md "SLO
+        # attribution & goodput"): per-request TTFT/ITL measured at the
+        # edge against --slo-ttft-ms/--slo-itl-ms targets. Shared code
+        # path with the cluster simulator's SimReport counts
+        # (telemetry/slo.py SloAttribution).
+        self.slo_violations = Counter(
+            "dynamo_slo_violations_total",
+            "Completed requests that breached a latency SLO target",
+            ["slo", "priority"],  # ttft|itl x low|normal|high
+            registry=self.registry,
+        )
+        self.goodput_requests = Counter(
+            "dynamo_goodput_requests_total",
+            "Completed requests that met every configured SLO target",
+            ["priority"],
+            registry=self.registry,
+        )
 
     # ------------------------------------------------------------ recorder
     def configure(self, trace_file: str | None) -> None:
-        """Point span recording at a JSONL file (None disables)."""
+        """Point span recording at a JSONL file (None disables).
+
+        The recorder is bounded: it rotates at ``DYN_TRACE_ROTATE_MB``
+        megabytes (default 64) keeping ``DYN_TRACE_KEEP`` older
+        generations (default 4), so a long-lived worker can leave
+        tracing on without growing the file forever. An atexit hook
+        flushes and closes the live file once per process, so a worker
+        dying between spans doesn't lose its buffered tail (torn lines
+        from a hard kill are skipped at replay)."""
         from ..recorder import Recorder
 
         with self._rec_lock:
@@ -280,7 +351,29 @@ class Telemetry:
                 self._recorder.close()
                 self._recorder = None
             if trace_file:
-                self._recorder = Recorder(trace_file)
+                self._recorder = Recorder(
+                    trace_file,
+                    max_bytes=int(
+                        _env_float("DYN_TRACE_ROTATE_MB", 64.0) * (1 << 20)
+                    ),
+                    max_files=int(_env_float("DYN_TRACE_KEEP", 4.0)),
+                )
+                self._register_atexit_flush()
+
+    def _register_atexit_flush(self) -> None:
+        if getattr(self, "_atexit_registered", False):
+            return
+        self._atexit_registered = True
+        atexit.register(self._flush_at_exit)
+
+    def _flush_at_exit(self) -> None:
+        """Crash-flush: close the live recorder so its tail reaches the
+        OS even when the process dies without calling configure(None)."""
+        with self._rec_lock:
+            rec, self._recorder = self._recorder, None
+            if rec is not None:
+                with contextlib.suppress(Exception):
+                    rec.close()
 
     def configure_from_env(self) -> None:
         """Honor ``DYN_TRACE_FILE`` if set.
@@ -353,6 +446,17 @@ class Telemetry:
         from prometheus_client import generate_latest
 
         return generate_latest(self.registry)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring invalid %s=%r", name, raw)
+        return default
 
 
 class _ActiveSpan:

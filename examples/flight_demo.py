@@ -1,0 +1,92 @@
+"""Flight-recorder demo (``make flight``): run a tiny engine, SIGUSR1
+it, render the dump.
+
+Walks the full operator path from docs/observability.md "Engine flight
+recorder & watchdog" in one process:
+
+1. build + start a TINY CPU engine (flight ring on, explicit dump path),
+2. serve a couple of requests so the ring has admission / dispatch /
+   consume / finish events,
+3. install the SIGUSR1 handler and send the signal to ourselves — the
+   same trigger an operator uses on a wedged production worker,
+4. render the dump with the ``llmctl flight`` code path.
+
+Usage: ``JAX_PLATFORMS=cpu python examples/flight_demo.py [dump_path]``
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import sys
+import time
+
+# Runnable straight from a checkout: `python examples/flight_demo.py`.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    dump_path = (
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else "/tmp/dynamo_flight_demo.jsonl"
+    )
+    if os.path.exists(dump_path):
+        os.remove(dump_path)
+
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.llmctl import main as llmctl_main
+    from dynamo_exp_tpu.models import TINY
+    from dynamo_exp_tpu.parallel import single_device_mesh
+    from dynamo_exp_tpu.protocols.common import BackendInput
+    from dynamo_exp_tpu.telemetry.flight import install_sigusr1
+
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=2,
+        page_size=8,
+        num_pages=64,
+        max_model_len=128,
+        eos_token_ids=[],
+        kv_dtype="float32",
+        decode_window=4,
+        flight_dump_path=dump_path,
+    )
+    engine = TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+    engine.start()
+
+    async def serve() -> int:
+        async def one(start: int) -> int:
+            b = BackendInput(token_ids=list(range(start, start + 16)))
+            b.stop_conditions.max_tokens = 10
+            b.stop_conditions.ignore_eos = True
+            stream = await engine.generate(b.to_dict())
+            n = 0
+            async for item in stream:
+                n += len(item.get("token_ids", []))
+            return n
+
+        totals = await asyncio.gather(one(20), one(60))
+        return sum(totals)
+
+    print("# serving 2 requests on a TINY engine...", file=sys.stderr)
+    tokens = asyncio.run(serve())
+    print(f"# generated {tokens} tokens; sending SIGUSR1", file=sys.stderr)
+
+    assert install_sigusr1(), "SIGUSR1 unavailable on this platform"
+    os.kill(os.getpid(), signal.SIGUSR1)
+    deadline = time.monotonic() + 5
+    while not os.path.exists(dump_path) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    engine.stop()
+    if not os.path.exists(dump_path):
+        print("no flight dump appeared", file=sys.stderr)
+        return 1
+
+    print(f"# rendering {dump_path} via `llmctl flight`:", file=sys.stderr)
+    return llmctl_main(["flight", dump_path])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -388,6 +388,112 @@ async def test_sim_matches_live_overload_harness(pressure_engine, seed):
     assert abs(rep.preemptions - live_preemptions) <= 2
 
 
+@pytest.mark.nightly
+@pytest.mark.parametrize("seed", SEEDS[:1])
+async def test_slo_attribution_live_and_sim_share_code_path(
+    pressure_engine, seed
+):
+    """Calibration (docs/observability.md "SLO attribution & goodput"):
+    the live edge and the simulator count goodput/violations through
+    the SAME telemetry.SloAttribution code path on the same seeded
+    overload burst. With unreachable targets every completed request is
+    goodput on both sides — and the counts match EXACTLY (completion
+    counts already calibrate exactly); with impossible targets every
+    completed request is a TTFT violation on both sides."""
+    import asyncio
+
+    from dynamo_exp_tpu.http.admission import (
+        AdmissionController,
+        RequestShedError,
+    )
+    from dynamo_exp_tpu.protocols.common import (
+        BackendInput,
+        SamplingOptions,
+        parse_priority,
+    )
+    from dynamo_exp_tpu.runtime.transports.chaos import overload_burst
+    from dynamo_exp_tpu.telemetry import SloAttribution, SloConfig
+
+    burst = overload_burst(seed, n=8, osl_range=(6, 12))
+    adm = AdmissionController(
+        max_inflight=PRESSURE_CFG["max_inflight"],
+        shed_watermark=PRESSURE_CFG["shed_watermark"],
+    )
+    # Two live attributions fed by the same measured latencies — the
+    # edge's record() call, made here with the timings the HTTP layer
+    # would have measured.
+    live_lax = SloAttribution(SloConfig(ttft_s=1e9, itl_s=1e9))
+    live_strict = SloAttribution(SloConfig(ttft_s=1e-12, itl_s=None))
+
+    async def submit(b):
+        try:
+            adm.acquire(parse_priority(b.priority))
+        except RequestShedError as e:
+            return ("shed", e.status)
+        try:
+            bi = BackendInput(
+                token_ids=list(b.prompt), priority=parse_priority(b.priority)
+            )
+            bi.stop_conditions.max_tokens = b.max_tokens
+            bi.stop_conditions.ignore_eos = True
+            bi.sampling_options = SamplingOptions(temperature=0.9, seed=b.seed)
+            t0 = time.monotonic()
+            ttft = None
+            t_first = t_last = 0.0
+            tokens = 0
+            stream = await pressure_engine.generate(bi.to_dict())
+            final = None
+            async for item in stream:
+                got = item.get("token_ids") or []
+                if got:
+                    now = time.monotonic()
+                    if ttft is None:
+                        ttft = now - t0
+                        t_first = now
+                    t_last = now
+                    tokens += len(got)
+                if item.get("finish_reason"):
+                    final = item["finish_reason"]
+            if final == "length":
+                itl = (
+                    (t_last - t_first) / (tokens - 1) if tokens > 1 else None
+                )
+                for attr in (live_lax, live_strict):
+                    attr.record(b.priority, ttft_s=ttft, itl_s=itl)
+            return ("done", final)
+        finally:
+            adm.release()
+
+    results = await asyncio.wait_for(
+        asyncio.gather(*[submit(b) for b in burst]), timeout=90
+    )
+    live_done = sum(1 for r in results if r == ("done", "length"))
+    assert live_done > 0
+
+    # Unreachable targets: completed == goodput, zero violations — and
+    # the sim agrees exactly (its completion count calibrates exactly).
+    sim_lax = _pressure_sim(
+        seed, slo=SloTargets(ttft_p99_slo_s=1e9, itl_p99_slo_s=1e9)
+    )
+    rep_lax = sim_lax.run()
+    assert live_lax.completed == live_lax.goodput_total == live_done
+    assert rep_lax.goodput_requests == rep_lax.completed == live_done
+    assert rep_lax.slo_violations_ttft == live_lax.violations["ttft"] == 0
+
+    # Impossible TTFT target: every completed request violates, on the
+    # live side and in the sim, through the same count() path.
+    sim_strict = _pressure_sim(
+        seed, slo=SloTargets(ttft_p99_slo_s=1e-12, itl_p99_slo_s=0.0)
+    )
+    rep_strict = sim_strict.run()
+    assert live_strict.violations["ttft"] == live_done
+    assert live_strict.goodput_total == 0
+    assert rep_strict.slo_violations_ttft == rep_strict.completed == live_done
+    assert rep_strict.goodput_requests == 0
+    # Same class, same instance types — the shared-path guarantee.
+    assert type(sim_lax.slo_attr) is type(live_lax)
+
+
 # ------------------------------------------------------------- admission
 def test_admission_resize_moves_bounds():
     from dynamo_exp_tpu.http.admission import AdmissionController

@@ -1,0 +1,177 @@
+"""SLO / goodput attribution (docs/observability.md "SLO attribution &
+goodput").
+
+The shared helper (``telemetry/slo.py SloAttribution``) is the single
+code path behind the live edge's
+``dynamo_slo_violations_total`` / ``dynamo_goodput_requests_total``
+counters, the live planner's ``plan_step_slo`` pressure inputs, and the
+simulator's ``SimReport`` goodput/violation counts. These tests cover
+the helper's units, the HTTP edge measuring per-request TTFT/ITL into
+it, and the planner pulling its pressure window from it. The
+calibration test tying the live edge and the sim together on the
+overload harness lives in ``tests/test_sim.py``
+(``test_slo_attribution_live_and_sim_share_code_path``).
+"""
+
+import pytest
+
+from dynamo_exp_tpu.engines.echo import EchoEngineFull
+from dynamo_exp_tpu.http import HttpService
+from dynamo_exp_tpu.telemetry import SloAttribution, SloConfig, get_telemetry
+
+
+# ------------------------------------------------------------------- units
+def test_violation_and_goodput_counting():
+    a = SloAttribution(SloConfig(ttft_s=1.0, itl_s=0.1))
+    assert a.record(1, ttft_s=0.5, itl_s=0.05) == ()
+    assert a.record("high", ttft_s=2.0, itl_s=0.05) == ("ttft",)
+    assert a.record(0, ttft_s=0.5, itl_s=0.5) == ("itl",)
+    assert a.record(1, ttft_s=2.0, itl_s=0.5) == ("ttft", "itl")
+    assert a.completed == 4
+    assert a.violations == {"ttft": 2, "itl": 2}
+    assert a.goodput_by_priority == {"normal": 1}
+    assert a.goodput_total == 1
+
+
+def test_unconfigured_axis_and_unmeasured_latency_never_violate():
+    a = SloAttribution(SloConfig(ttft_s=None, itl_s=0.1))
+    assert a.record(1, ttft_s=100.0, itl_s=None) == ()  # 1-token response
+    assert a.record(2, ttft_s=100.0, itl_s=0.05) == ()
+    assert a.goodput_total == 2
+    # No config at all: everything completed is goodput.
+    b = SloAttribution()
+    assert not b.cfg.active
+    assert b.record(1, ttft_s=9.9, itl_s=9.9) == ()
+    assert b.goodput_total == 1
+
+
+def test_window_percentiles_and_reset():
+    a = SloAttribution(SloConfig(ttft_s=1.0))
+    for t in (0.1, 0.2, 0.9):
+        a.observe_ttft(t)
+    a.observe_itl(0.05)
+    ttft_p99, itl_p99 = a.window_percentiles()
+    assert ttft_p99 == 0.9  # nearest-rank: p99 of 3 samples is the max
+    assert itl_p99 == 0.05
+    a.reset_window()
+    assert a.window_percentiles() == (None, None)
+    # Totals survive the window reset (counters are lifetime).
+    a.record(1, ttft_s=2.0)
+    assert a.violations["ttft"] == 1
+
+
+def test_prometheus_counters_mirrored():
+    tel = get_telemetry()
+    a = SloAttribution(SloConfig(ttft_s=1.0, itl_s=0.1), tel)
+    a.record(0, ttft_s=5.0, itl_s=0.05)
+    a.record(2, ttft_s=0.5, itl_s=0.05)
+    rendered = tel.render().decode()
+    assert 'dynamo_slo_violations_total{priority="low",slo="ttft"}' in rendered
+    assert 'dynamo_goodput_requests_total{priority="high"}' in rendered
+
+
+# ---------------------------------------------------------------- HTTP edge
+async def _serve_one(slo, stream: bool, priority=None):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    svc = HttpService(slo=slo)
+    svc.manager.add_chat_model("echo", EchoEngineFull(chunk_chars=3))
+    client = TestClient(TestServer(svc.app))
+    await client.start_server()
+    try:
+        body = {
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello world"}],
+            "stream": stream,
+        }
+        if priority is not None:
+            body["priority"] = priority
+        r = await client.post("/v1/chat/completions", json=body)
+        assert r.status == 200, await r.text()
+        await r.read()
+    finally:
+        await client.close()
+
+
+async def test_edge_records_streaming_request_as_goodput():
+    slo = SloAttribution(SloConfig(ttft_s=30.0, itl_s=30.0))
+    await _serve_one(slo, stream=True)
+    assert slo.completed == 1
+    assert slo.goodput_total == 1
+    assert slo.violations == {"ttft": 0, "itl": 0}
+    ttft_p99, itl_p99 = slo.window_percentiles()
+    assert ttft_p99 is not None and ttft_p99 > 0
+    # The echo stream has several chunks, so ITL was measurable.
+    assert itl_p99 is not None and itl_p99 >= 0
+
+
+async def test_edge_counts_violations_with_priority_label():
+    slo = SloAttribution(SloConfig(ttft_s=1e-9, itl_s=None))
+    await _serve_one(slo, stream=True, priority="low")
+    assert slo.completed == 1
+    assert slo.goodput_total == 0
+    assert slo.violations["ttft"] == 1
+    # Unary requests are attributed too (aggregated stream).
+    await _serve_one(slo, stream=False, priority="high")
+    assert slo.completed == 2 and slo.violations["ttft"] == 2
+
+
+async def test_edge_without_slo_is_untouched():
+    await _serve_one(None, stream=True)  # must simply not crash
+
+
+# ------------------------------------------------------------------ planner
+async def test_planner_pulls_pressure_from_slo_source():
+    """The live planner's plan_step_slo pressure inputs come from the
+    shared attribution window (and the window resets with the round,
+    like every other interval sample)."""
+    from dynamo_exp_tpu.planner import PlannerConfig, SloTargets
+    from dynamo_exp_tpu.planner.planner import Planner
+
+    class _NullQueue:
+        async def size(self):
+            return 0
+
+    class _NullDrt:
+        def namespace(self, name):
+            return self
+
+        def component(self, name):
+            return self
+
+        def work_queue(self, name):
+            return _NullQueue()
+
+    class _Conn:
+        def __init__(self):
+            self.calls = []
+
+        async def add_component(self, name):
+            self.calls.append(("add", name))
+            return True
+
+        async def remove_component(self, name):
+            self.calls.append(("remove", name))
+            return True
+
+    src = SloAttribution(SloConfig(ttft_s=1.0, itl_s=0.2))
+    cfg = PlannerConfig(
+        slo=SloTargets(ttft_p99_slo_s=1.0, itl_p99_slo_s=0.2),
+        max_tpu_budget=8,
+    )
+    conn = _Conn()
+    p = Planner(_NullDrt(), cfg, connector=conn, slo_source=src)
+    # A breached-TTFT window: pressure > 1 -> decode scale-up, even
+    # though KV looks calm.
+    src.observe_ttft(3.0)
+    p.kv_load = [0.3]
+    await p.make_adjustments_with_counts([], [1])
+    assert p.ttft_p99_s == 3.0  # pulled from the shared window
+    assert ("add", cfg.decode_component) in conn.calls
+    # The pull reset the window: a quiet next round sees no stale breach.
+    assert src.window_percentiles() == (None, None)
+    conn.calls.clear()
+    p.kv_load = [0.3]
+    await p.make_adjustments_with_counts([], [2])
+    assert p.ttft_p99_s is None
+    assert ("add", cfg.decode_component) not in conn.calls

@@ -121,6 +121,50 @@ def test_configure_from_env_records_per_process(tmp_path, monkeypatch):
     assert [s.stage for s in load_spans([base])] == ["from-env"]
 
 
+def test_recorder_rotation_env_and_atexit_flush(tmp_path, monkeypatch):
+    """Satellite: the span recorder is bounded — DYN_TRACE_ROTATE_MB /
+    DYN_TRACE_KEEP size the rotation, and the atexit flush hook closes
+    the live file so a dying worker doesn't lose its tail."""
+    monkeypatch.setenv("DYN_TRACE_ROTATE_MB", "0.001")  # ~1 KiB
+    monkeypatch.setenv("DYN_TRACE_KEEP", "2")
+    tel = get_telemetry()
+    path = str(tmp_path / "t.jsonl")
+    tel.configure(path)
+    try:
+        assert tel._recorder.max_bytes == int(0.001 * (1 << 20))
+        assert tel._recorder.max_files == 2
+        for i in range(40):  # ~150 bytes/span: forces rotation
+            with span("rot", i=i):
+                pass
+        import os
+
+        assert os.path.exists(path + ".1"), "no rotation happened"
+        assert not os.path.exists(path + ".3")  # keep-N bound
+        # Bounded retention: the newest spans survive across the kept
+        # generations; older generations were deleted (the point of the
+        # bound), never grown forever.
+        spans = load_spans([path])
+        assert 0 < len(spans) < 40
+        assert max(s.attrs["i"] for s in spans) == 39  # newest kept
+        # Crash-flush path: the atexit hook closes the live recorder
+        # (idempotent; a normal configure(None) later is a no-op).
+        assert tel._atexit_registered
+        tel._flush_at_exit()
+        assert tel._recorder is None
+    finally:
+        tel.configure(None)
+
+
+def test_invalid_rotation_env_falls_back(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_TRACE_ROTATE_MB", "not-a-number")
+    tel = get_telemetry()
+    tel.configure(str(tmp_path / "t.jsonl"))
+    try:
+        assert tel._recorder.max_bytes == 64 << 20  # default
+    finally:
+        tel.configure(None)
+
+
 def test_load_spans_follows_rotations_and_skips_non_numeric(tmp_path):
     tel = get_telemetry()
     path = str(tmp_path / "t.jsonl")
@@ -448,31 +492,74 @@ async def test_card_sweep_still_removes_stale_cards():
 
 
 # ---------------------------------------------------- metric doc-sync guard
-def test_every_registered_metric_name_is_documented():
-    """Doc-sync guard: every ``dynamo_*`` metric registered by the
-    telemetry hub must appear in docs/observability.md — new counters
-    land with their documentation or not at all (this is exactly the
-    drift a PR adding counters would otherwise start)."""
+def _observability_doc() -> str:
     import os
-
-    from prometheus_client import CollectorRegistry
-
-    from dynamo_exp_tpu.telemetry.spans import Telemetry
 
     doc_path = os.path.join(
         os.path.dirname(__file__), "..", "docs", "observability.md"
     )
     with open(doc_path) as f:
-        doc = f.read()
+        return f.read()
+
+
+def test_every_registered_metric_name_is_documented():
+    """Doc-sync guard: every ``dynamo_*`` metric registered by the
+    telemetry hub — counters, gauges, AND histograms — must appear in
+    docs/observability.md; new series land with their documentation or
+    not at all (this is exactly the drift a PR adding counters would
+    otherwise start)."""
+    from prometheus_client import CollectorRegistry
+
+    from dynamo_exp_tpu.telemetry.spans import Telemetry
+
+    doc = _observability_doc()
     hub = Telemetry(CollectorRegistry())
     missing = []
+    seen_types = set()
     for family in hub.registry.collect():
+        seen_types.add(family.type)
         # The client lib reports counters by base name; the exposition
         # (and the docs) use the _total suffix.
         name = family.name + ("_total" if family.type == "counter" else "")
         if name.startswith("dynamo_") and name not in doc:
             missing.append(name)
+    # The walk really does cover all three instrument kinds (a refactor
+    # that silently dropped one family type would hollow the guard out).
+    assert {"counter", "gauge", "histogram"} <= seen_types
     assert not missing, (
         f"metrics registered in telemetry/ but undocumented in "
         f"docs/observability.md: {sorted(missing)}"
     )
+
+
+def test_every_engine_metrics_mirror_key_is_documented():
+    """Doc-sync guard (PR 9 extension): every ``engine.metrics()``
+    mirror key — including the host-tier keys and the per-kind
+    dispatch-profiler stat fields — must appear in
+    docs/observability.md, so the stats-plane surface bench.py and the
+    sim fit consume can't drift undocumented."""
+    from dynamo_exp_tpu.telemetry.dispatch import SUMMARY_FIELDS
+
+    doc = _observability_doc()
+    engine = make_engine()
+    try:
+        # Host tier on a throwaway copy of the config surface: the
+        # host_cache_* keys only exist when the tier is enabled.
+        m = dict(engine.metrics())
+        m.update(
+            {"host_cache_resident": 0, "host_cache_hits": 0,
+             "host_cache_stores": 0}
+        )
+        missing = [k for k in m if f"`{k}`" not in doc]
+        assert not missing, (
+            f"engine.metrics() keys undocumented in "
+            f"docs/observability.md: {sorted(missing)}"
+        )
+        # The dispatch mirror's per-kind stat fields are part of the
+        # contract too (bench lines carry them verbatim).
+        undocumented_fields = [
+            f for f in SUMMARY_FIELDS if f"`{f}`" not in doc
+        ]
+        assert not undocumented_fields, undocumented_fields
+    finally:
+        engine.stop()
