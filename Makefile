@@ -80,5 +80,11 @@ flight:
 profile-smoke:
 	$(PYTEST) tests/test_dispatch_profile.py -q -k overhead
 
+# Style lint (ruff) + dynlint, the AST invariant checkers
+# (docs/static_analysis.md): host-sync / determinism / thread-ownership
+# / recompile-hazard over the package tree, zero unwaived findings.
+# Runs in the pre-merge lane next to `make chaos`; the same gate is a
+# tier-1 test (tests/test_analysis.py).
 lint:
 	ruff check dynamo_exp_tpu/ tests/ bench.py __graft_entry__.py
+	python -m dynamo_exp_tpu.llmctl lint --json

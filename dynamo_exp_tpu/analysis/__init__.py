@@ -1,0 +1,56 @@
+"""dynlint: AST invariant checkers for the engine's hot-path contracts.
+
+Four properties this codebase leans on live here as machine-checked
+rules instead of CHANGES.md folklore (docs/static_analysis.md):
+
+- ``host-sync`` — declared hot-path zones (engine loop, scheduler,
+  offload/CopyStream, dispatch profiler) may not add implicit
+  device→host syncs; every legitimate sync point is an inline-waived,
+  reviewed allowlist entry. Complements the *runtime* sync-spy in
+  tests/test_dispatch_profile.py: the spy counts syncs in one driven
+  scenario, the checker polices every code path at diff time.
+- ``determinism`` — seed-deterministic zones (``sim/``, ``spec/``, the
+  chaos schedules, flight-recorder payload construction) may not read
+  wall clocks, unseeded RNGs, ``uuid``/``os.urandom``, or leak
+  ``id()``/``hash()`` into recorded payloads. Complements the runtime
+  bit-identity tests (tests/test_sim.py, tests/test_flight.py).
+- ``thread-ownership`` — a manifest declares which engine attributes
+  only the loop thread may mutate and which surfaces are cross-thread
+  handoffs (``_submit_q``, ``_lease_confirm_q``, …); writes to
+  loop-owned state on call paths reachable from non-loop entry points
+  are flagged, as are accesses to lock-guarded state outside its
+  ``with lock:`` block.
+- ``recompile-hazard`` — dispatch sites that key compiled-variant
+  caches (``_decode_fns``/``_prefill_fns``/``_spec_fns``, the
+  gather/scatter page movers) must derive shape-carrying key
+  components through the ``*_bucket_for`` helpers; a raw dynamic int
+  in a variant key is a recompile storm waiting for an unlucky load.
+
+Everything here is pure stdlib (``ast`` + ``re``): ``python -m
+dynamo_exp_tpu.analysis`` runs with no jax/pydantic installed, which is
+what lets the CI lint job gate on it without the full dependency image.
+"""
+
+from .core import Finding, Zone, parse_waivers
+from .determinism import DeterminismChecker
+from .host_sync import HostSyncChecker
+from .ownership import LockManifest, ThreadManifest, ThreadOwnershipChecker
+from .recompile import RecompileHazardChecker, VariantSiteManifest
+from .runner import RULES, WAIVER_TOKENS, lint_tree, run_cli
+
+__all__ = [
+    "Finding",
+    "Zone",
+    "parse_waivers",
+    "HostSyncChecker",
+    "DeterminismChecker",
+    "ThreadOwnershipChecker",
+    "ThreadManifest",
+    "LockManifest",
+    "RecompileHazardChecker",
+    "VariantSiteManifest",
+    "RULES",
+    "WAIVER_TOKENS",
+    "lint_tree",
+    "run_cli",
+]

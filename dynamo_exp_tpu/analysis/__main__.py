@@ -1,0 +1,11 @@
+"""``python -m dynamo_exp_tpu.analysis`` — dynlint without the heavy
+deps (pure stdlib), so the CI lint job can gate on it with a bare
+interpreter. ``llmctl lint`` exposes the same flags on the operator
+CLI."""
+
+import sys
+
+from .runner import main
+
+if __name__ == "__main__":
+    sys.exit(main())

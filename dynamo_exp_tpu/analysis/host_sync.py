@@ -1,0 +1,314 @@
+"""host-sync checker: no implicit device→host syncs in hot-path zones.
+
+What blocks the host on a jax value (and therefore the engine loop,
+when it happens there):
+
+- ``np.asarray`` / ``np.array`` / ``np.ascontiguousarray`` /
+  ``jax.device_get`` on a device value — the canonical consume points;
+- ``.item()`` / ``.tolist()`` / ``.block_until_ready()``;
+- ``int()`` / ``float()`` / ``bool()`` of a jax value;
+- truthiness (``if x:`` / ``not x`` / ``x and y``) on a jax value.
+
+The checker runs light per-function dataflow so it can tell the two
+sides of a sync apart: a name assigned from ``jnp.*``/``jax.*`` is
+DEVICE-classified; a name assigned from the ``np.*`` family is HOST.
+``int(token)`` over rows of an already-materialized ``np.asarray``
+result is host-side bookkeeping and is *not* flagged — only the
+materialization itself is, so the waiver allowlist stays a list of
+true sync points, one per dispatch consume. Conversion calls whose
+argument can't be proven HOST are flagged conservatively: a reviewed
+``# dynlint: sync-point(reason)`` is exactly the point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    Finding,
+    ScopeIndex,
+    Zone,
+    attr_chain,
+    base_name,
+    dataflow_units,
+    own_nodes,
+    zone_for,
+)
+
+RULE = "host-sync"
+
+# Conversion calls that materialize (sync) a device argument.
+_CONVERT_CALLS = {
+    ("np", "asarray"),
+    ("np", "array"),
+    ("np", "ascontiguousarray"),
+    ("numpy", "asarray"),
+    ("numpy", "array"),
+    ("numpy", "ascontiguousarray"),
+    ("jax", "device_get"),
+}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_CASTS = {"int", "float", "bool"}
+
+_DEVICE = "device"
+_HOST = "host"
+
+
+def _is_np_root(chain: tuple[str, ...]) -> bool:
+    return bool(chain) and chain[0] in ("np", "numpy")
+
+
+def _is_device_root(chain: tuple[str, ...]) -> bool:
+    return bool(chain) and chain[0] in ("jnp", "jax")
+
+
+# Attributes known to hold device values engine-wide (the persistent
+# jax state a cast/truthiness on which is always a sync). Local names
+# get classified by dataflow; these cover the `self.<attr>` /
+# `pending.<attr>` spellings dataflow can't see.
+DEVICE_ATTRS = frozenset(
+    {"k_cache", "v_cache", "_counts", "params", "tokens_dev", "positions_dev"}
+)
+
+
+class _FunctionFlow(ast.NodeVisitor):
+    """One function's name classification (DEVICE / HOST / unknown)."""
+
+    def __init__(self, device_attrs: frozenset[str] = DEVICE_ATTRS) -> None:
+        self.classes: dict[str, str] = {}
+        self.device_attrs = device_attrs
+
+    # ------------------------------------------------------ classification
+    def classify(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain in _CONVERT_CALLS:
+                return _HOST
+            if _is_device_root(chain):
+                return _DEVICE
+            if _is_np_root(chain):
+                return _HOST  # np.zeros/np.full/... build host buffers
+            # A method call on a device value yields another device
+            # value (`x.any()`, `x.sum()`) — except the sync methods,
+            # whose results are host scalars/lists.
+            if isinstance(node.func, ast.Attribute):
+                if self.classify(node.func.value) == _DEVICE:
+                    return (
+                        _HOST
+                        if node.func.attr in _SYNC_METHODS
+                        else _DEVICE
+                    )
+            return None
+        if isinstance(node, ast.GeneratorExp):
+            # `(np.asarray(y) for y in pending.ys)` — unpacking targets
+            # inherit the element's class (the consume-site idiom).
+            return self.classify(node.elt)
+        if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+            inner = node
+            while isinstance(inner, ast.Subscript):
+                inner = inner.value
+            chain = attr_chain(inner)
+            # Dotted access only (`self._counts`, `pending.tokens_dev`):
+            # a bare local that happens to share a name stays dataflow-
+            # classified.
+            if len(chain) >= 2 and chain[-1] in self.device_attrs:
+                return _DEVICE
+            base = base_name(node)
+            if base is not None:
+                return self.classes.get(base)
+            return None
+        if isinstance(node, ast.Tuple):
+            kinds = {self.classify(e) for e in node.elts}
+            if len(kinds) == 1:
+                return kinds.pop()
+        if isinstance(node, ast.Constant):
+            return _HOST
+        return None
+
+    def _bind(self, target: ast.AST, kind: str | None) -> None:
+        if kind is None:
+            return
+        if isinstance(target, ast.Name):
+            # DEVICE is sticky: a later host rebind (`x = np.asarray(x)`)
+            # must not retroactively exempt the materializing call — the
+            # classification is flow-insensitive, so the conservative
+            # merge keeps the device taint for the whole function.
+            if kind == _HOST and self.classes.get(target.id) == _DEVICE:
+                return
+            self.classes[target.id] = kind
+        elif isinstance(target, ast.Tuple):
+            for e in target.elts:
+                self._bind(e, kind)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        kind = self.classify(node.value)
+        for t in node.targets:
+            self._bind(t, kind)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._bind(node.target, self.classify(node.value))
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        # Iterating a HOST array yields host rows; a DEVICE value
+        # yields device slices.
+        self._bind(node.target, self.classify(node.iter))
+        self.generic_visit(node)
+
+    # Don't descend into nested defs: they get their own flow pass.
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+
+class HostSyncChecker:
+    """Flags implicit device→host syncs inside declared hot-path zones."""
+
+    rule = RULE
+
+    def __init__(self, zones: tuple[Zone, ...] | None = None):
+        if zones is None:
+            from .zones import HOT_PATH_ZONES
+
+            zones = HOT_PATH_ZONES
+        self.zones = zones
+
+    # ----------------------------------------------------------- interface
+    def check(
+        self, rel_path: str, tree: ast.Module, source: str
+    ) -> list[Finding]:
+        zone = zone_for(self.zones, rel_path)
+        if zone is None:
+            return []
+        scopes = ScopeIndex(tree)
+        findings: list[Finding] = []
+        # One dataflow unit per function (plus the module body): nested
+        # defs are their own unit, never re-checked under the outer
+        # function's name classification.
+        for unit in dataflow_units(tree):
+            flow = _FunctionFlow()
+            body = unit.body if isinstance(unit.body, list) else []
+            for stmt in body:
+                flow.visit(stmt)
+            for node in own_nodes(unit):
+                self._check_node(rel_path, node, flow, zone, scopes, findings)
+        return findings
+
+    def check_source(self, rel_path: str, source: str) -> list[Finding]:
+        return self.check(rel_path, ast.parse(source), source)
+
+    # ------------------------------------------------------------ internals
+
+    def _check_node(
+        self,
+        rel_path: str,
+        node: ast.AST,
+        flow: _FunctionFlow,
+        zone: Zone,
+        scopes: ScopeIndex,
+        findings: list[Finding],
+    ) -> None:
+        def flag(n: ast.AST, message: str) -> None:
+            if not scopes.in_scope(n, zone):
+                return
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    file=rel_path,
+                    line=n.lineno,
+                    col=n.col_offset,
+                    end_line=getattr(n, "end_lineno", n.lineno) or n.lineno,
+                    message=message,
+                )
+            )
+
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain in _CONVERT_CALLS:
+                arg = (
+                    node.args[0]
+                    if node.args
+                    else (node.keywords[0].value if node.keywords else None)
+                )
+                if arg is not None and flow.classify(arg) != _HOST:
+                    flag(
+                        node,
+                        f"implicit device→host sync: "
+                        f"{'.'.join(chain)}(...) in a hot-path zone",
+                    )
+                return
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+            ):
+                # A receiver dataflow already proved HOST (the result of
+                # an np.* materialization) is bookkeeping, not a sync.
+                if flow.classify(node.func.value) != _HOST:
+                    flag(
+                        node,
+                        f".{node.func.attr}() blocks on the device "
+                        f"in a hot-path zone",
+                    )
+                return
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _CASTS
+                and node.args
+                and flow.classify(node.args[0]) == _DEVICE
+            ):
+                flag(
+                    node,
+                    f"{node.func.id}() of a jax value forces a host sync",
+                )
+                return
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            for expr, why in self._truthy_exprs(node.test):
+                if flow.classify(expr) == _DEVICE:
+                    flag(expr, f"{why} of a jax value forces a host sync")
+        elif isinstance(node, ast.comprehension):
+            for cond in node.ifs:
+                for expr, why in self._truthy_exprs(cond):
+                    if flow.classify(expr) == _DEVICE:
+                        flag(
+                            expr, f"{why} of a jax value forces a host sync"
+                        )
+        elif isinstance(node, ast.BoolOp):
+            for v in node.values:
+                for expr, why in self._truthy_exprs(v):
+                    if flow.classify(expr) == _DEVICE:
+                        flag(
+                            expr, f"{why} of a jax value forces a host sync"
+                        )
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            for expr, why in self._truthy_exprs(node.operand):
+                if flow.classify(expr) == _DEVICE:
+                    flag(expr, f"{why} of a jax value forces a host sync")
+
+    @staticmethod
+    def _truthy_exprs(test: ast.AST):
+        """Expressions evaluated for truth in a test position: the bare
+        value itself, and — the common accidental-sync idiom — each
+        side of a comparison (`if n > 0:` blocks exactly like
+        `if n:`). Identity checks (`is` / `is not`) never materialize
+        the array and are skipped — `if self.k_cache is None:` is the
+        lazy-init idiom, not a sync. BoolOp / `not` sub-expressions
+        yield nothing here: the tree walk visits those nodes directly,
+        so expanding them again would double-report."""
+        if isinstance(test, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return
+            for e in [test.left, *test.comparators]:
+                yield e, "comparison"
+        elif isinstance(test, ast.BoolOp) or (
+            isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not)
+        ):
+            return
+        else:
+            yield test, "truthiness"

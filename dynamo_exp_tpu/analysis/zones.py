@@ -1,0 +1,178 @@
+"""Declared dynlint zones and manifests (docs/static_analysis.md).
+
+This file is the one place the lint suite learns *where* each contract
+applies. The upcoming ragged-kernel refactor rewrites dispatch sites —
+when files split or move, update the declarations here (and the doc)
+and the checkers follow.
+"""
+
+from __future__ import annotations
+
+from .core import Zone
+from .ownership import LockManifest, ThreadManifest
+from .recompile import VariantSiteManifest
+
+# --------------------------------------------------------- host-sync zones
+# The engine hot path: code that runs on (or hands work to) the engine
+# loop thread, where one accidental blocking transfer serializes the
+# dispatch pipeline (docs/engine_perf.md "Dispatch/host overlap").
+# ``generate``/``prefill_extract`` are excluded: they run on asyncio
+# threads at submission time and never touch device values.
+HOT_PATH_ZONES: tuple[Zone, ...] = (
+    Zone(
+        "dynamo_exp_tpu/engine/engine.py",
+        exclude=("TPUEngine.generate", "TPUEngine.prefill_extract"),
+    ),
+    Zone("dynamo_exp_tpu/engine/scheduler.py"),
+    Zone("dynamo_exp_tpu/engine/offload.py"),
+    Zone("dynamo_exp_tpu/engine/kv_manager.py"),
+    # The profiler's whole contract is "zero added host syncs"
+    # (docs/observability.md); the checker turns that claim into a
+    # standing property instead of one driven smoke test.
+    Zone("dynamo_exp_tpu/telemetry/dispatch.py"),
+)
+
+# ------------------------------------------------------ determinism zones
+# Seed-deterministic code: same seed must mean bit-identical outputs
+# across runs and hosts (docs/simulation.md "Determinism rules", the
+# flight-recorder bit-identity test). The FlightRecorder class is in
+# zone because its ring payloads are compared across runs; the Watchdog
+# in the same file is wall-clock-driven by design and stays out.
+DETERMINISM_ZONES: tuple[Zone, ...] = (
+    Zone("dynamo_exp_tpu/sim/"),
+    Zone("dynamo_exp_tpu/spec/"),
+    Zone("dynamo_exp_tpu/runtime/transports/chaos.py"),
+    Zone("dynamo_exp_tpu/telemetry/flight.py", include=("FlightRecorder",)),
+)
+
+# ------------------------------------------------- thread-ownership model
+# Engine-loop-owned state vs cross-thread handoff surfaces. The PR 5
+# gotcha this encodes: scheduler/page state may only be mutated on the
+# loop thread, with no decode window in flight over the pages involved;
+# other threads talk to the loop through the queues and events below.
+OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
+    ThreadManifest(
+        path="dynamo_exp_tpu/engine/engine.py",
+        cls="TPUEngine",
+        loop_entries=("_loop",),
+        external_entries=(
+            "generate",  # asyncio ingress
+            "prefill_extract",  # asyncio ingress (disagg prefill)
+            "confirm_kv_lease",  # prefill worker's delivery ack thread
+            "start",
+            "stop",
+            "metrics",  # /metrics scrapes from serving threads
+            "_flight_snapshot",  # watchdog thread
+            "_dump_flight",  # watchdog / SIGUSR1 / crash paths
+        ),
+        loop_owned=frozenset(
+            {
+                "sched",
+                "kv",
+                "k_cache",
+                "v_cache",
+                "params",
+                "_counts",
+                "_inflight",
+                "_pending_offloads",
+                "_decode_fns",
+                "_prefill_fns",
+                "_spec_fns",
+                "_spec",
+                "steps",
+                "wasted_steps",
+                "kv_page_moves",
+                "kv_move_dispatches",
+                "preempted",
+                "spec_dispatches",
+                "spec_row_dispatches",
+                "spec_draft_tokens",
+                "spec_accepted_tokens",
+                "spec_emitted_tokens",
+                "_progress_mark",
+                "_last_move_t",
+                "_last_gauge_pub",
+                "_last_reap",
+            }
+        ),
+        handoff=frozenset(
+            {
+                # Queues/events other threads feed the loop through.
+                "_submit_q",
+                "_lease_confirm_q",
+                "_wake",
+                # Lifecycle flags/threads, written only before the loop
+                # starts or after it is joined.
+                "_running",
+                "_thread",
+                "_watchdog",
+                "_flight_handle",
+                "copy_stream",
+                # Internally synchronized (lock / GIL-relying, see the
+                # lock manifests and DispatchProfiler docstring).
+                "host_pool",
+                "flight",
+                "profiler",
+                "cfg",
+                "mesh",
+                "_seed_rng",  # submission-side only (asyncio threads)
+                "_gather_pages",
+                "_inject_pages",
+                "_init_row",
+                "_attn_impl",
+                "_attn_interpret",
+            }
+        ),
+    ),
+)
+
+# Lock-guarded shared state: every read or write of a guarded attribute
+# inside its class must sit under ``with self.<lock>:``.
+LOCK_MANIFESTS: tuple[LockManifest, ...] = (
+    LockManifest(
+        path="dynamo_exp_tpu/engine/offload.py",
+        cls="HostKvPool",
+        lock="_lock",
+        guarded=frozenset(
+            {"_k", "_v", "_free", "_by_hash", "stores", "hits", "evictions"}
+        ),
+    ),
+    LockManifest(
+        path="dynamo_exp_tpu/telemetry/flight.py",
+        cls="FlightRecorder",
+        lock="_lock",
+        guarded=frozenset({"_ring", "_head", "seq"}),
+    ),
+    LockManifest(
+        path="dynamo_exp_tpu/telemetry/slo.py",
+        cls="SloAttribution",
+        lock="_lock",
+        guarded=frozenset(
+            {
+                "_win_ttft",
+                "_win_itl",
+                "completed",
+                "violations",
+                "goodput_by_priority",
+            }
+        ),
+    ),
+)
+
+# ------------------------------------------------- recompile-hazard sites
+# Callables whose listed argument positions become compiled-variant
+# cache keys (static shapes): those arguments must trace to a
+# ``*_bucket_for`` helper, a constant, or static config — never a raw
+# dynamic int (docs/engine_perf.md "Decode batch compaction").
+VARIANT_SITE_MANIFESTS: tuple[VariantSiteManifest, ...] = (
+    VariantSiteManifest(
+        path="dynamo_exp_tpu/engine/engine.py",
+        sites={
+            "_decode_fn": (0, 1),
+            "_prefill_fn": (0, 1, 2),
+            "_spec_fn": (0, 1, 2),
+            "_gather_pages": (2,),
+            "_inject_pages": (2,),
+        },
+    ),
+)

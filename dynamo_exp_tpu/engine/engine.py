@@ -671,6 +671,23 @@ class TPUEngine(AsyncEngine):
     def start(self) -> None:
         if self._running:
             return
+        if self._thread is not None:
+            if self._thread.is_alive():
+                # A wedged previous loop survived a timed-out stop(): a
+                # second loop thread would race it over scheduler/page/
+                # inflight state the moment the old one unwedges.
+                log.error(
+                    "previous engine loop thread is still alive; refusing "
+                    "to start a second loop"
+                )
+                return
+            # The wedged loop later unwedged and exited, but the timed-out
+            # stop() skipped its teardown: drop the stale in-flight window
+            # and buffered evictions — the pages they reference belong to
+            # the previous run.
+            self._thread = None
+            self._inflight = None  # dynlint: thread-ownership(loop thread joined before teardown flush)
+            self._pending_offloads.clear()  # dynlint: thread-ownership(loop thread joined before teardown flush)
         if self.host_pool is not None and self.copy_stream is None:
             # stop() tears the copy stream down; a restarted engine needs
             # a live one before the first eviction fires on_evict.
@@ -703,9 +720,19 @@ class TPUEngine(AsyncEngine):
             unregister_dumper(self._flight_handle)
             self._flight_handle = None
         if self._thread:
+            # The teardown below mutates loop-owned state, so it may only
+            # run once the loop thread has actually exited. A wedged loop
+            # (e.g. stuck in a pathological compile) keeps its state: a
+            # concurrent flush would race whatever it is still doing.
             self._thread.join(timeout=30)
+            if self._thread.is_alive():
+                log.error(
+                    "engine loop did not exit within 30s; skipping "
+                    "teardown flush to avoid racing the live loop thread"
+                )
+                return
             self._thread = None
-        self._inflight = None
+        self._inflight = None  # dynlint: thread-ownership(loop thread joined before teardown flush)
         if self.copy_stream is not None:
             # Flush evictions the dead loop buffered, then drain
             # (bounded) so a graceful drain doesn't silently discard
@@ -725,6 +752,13 @@ class TPUEngine(AsyncEngine):
     ) -> ResponseStream[dict]:
         if not self._running:
             self.start()
+            if not self._running:
+                # start() refused (wedged previous loop): submitting
+                # would enqueue work nothing will ever consume.
+                raise RuntimeError(
+                    "engine is not running (previous loop thread is "
+                    "still alive after a timed-out stop)"
+                )
         ctx = context or AsyncEngineContext()
         binput = (
             request
@@ -808,6 +842,11 @@ class TPUEngine(AsyncEngine):
         """
         if not self._running:
             self.start()
+            if not self._running:
+                raise RuntimeError(
+                    "engine is not running (previous loop thread is "
+                    "still alive after a timed-out stop)"
+                )
         ctx = context or AsyncEngineContext()
         binput = (
             request.model_copy(deep=True)  # never mutate the caller's object
@@ -1219,11 +1258,11 @@ class TPUEngine(AsyncEngine):
             self.k_cache, self.v_cache, jnp.asarray(padded)
         )
         if prof is not None:
-            self._last_move_t = prof.end(kind, t0, fresh)
+            self._last_move_t = prof.end(kind, t0, fresh)  # dynlint: thread-ownership(loop thread joined before teardown flush)
         if self.flight is not None:
             self.flight.record("dispatch", dispatch=kind, pages=len(pids))
-        self.kv_move_dispatches += 1
-        self.kv_page_moves += len(pids)
+        self.kv_move_dispatches += 1  # dynlint: thread-ownership(loop thread joined before teardown flush)
+        self.kv_page_moves += len(pids)  # dynlint: thread-ownership(loop thread joined before teardown flush)
         return k_b, v_b
 
     def _inject_page_batch(self, pids: list[int], k_pages, v_pages, op: str):
@@ -1272,7 +1311,7 @@ class TPUEngine(AsyncEngine):
         stream order then guarantees the gather reads the old content."""
         if not self._pending_offloads:
             return
-        moved, self._pending_offloads = self._pending_offloads, []
+        moved, self._pending_offloads = self._pending_offloads, []  # dynlint: thread-ownership(loop thread joined before teardown flush)
         if self.copy_stream is None:
             return
         k_b, v_b = self._gather_page_batch(
@@ -1365,7 +1404,11 @@ class TPUEngine(AsyncEngine):
             # caveat in docs/fault_tolerance.md.)
             V = self.cfg.model.vocab_size
             vec = np.zeros(V, np.int32)
-            tail = np.clip(np.asarray(seq.prompt[-resumed:], np.int64), 0, V - 1)
+            tail = np.clip(
+                np.asarray(seq.prompt[-resumed:], np.int64),  # dynlint: sync-point(host-list conversion)
+                0,
+                V - 1,
+            )
             np.add.at(vec, tail, 1)
             self._counts = self._counts.at[seq.slot].add(jnp.asarray(vec))
         seq.tokens.append(token)
@@ -1393,7 +1436,7 @@ class TPUEngine(AsyncEngine):
         if not pids:
             return [], ""
         k_b, v_b = self._gather_page_batch(pids)
-        k_np, v_np = np.asarray(k_b), np.asarray(v_b)  # the one sync
+        k_np, v_np = np.asarray(k_b), np.asarray(v_b)  # dynlint: sync-point(extract gather consume)
         if self.profiler is not None:
             self.profiler.consume("kv_move", self._last_move_t)
         if self.flight is not None:
@@ -1529,9 +1572,9 @@ class TPUEngine(AsyncEngine):
         if not pending.completed:
             return
         if pending.want_lp:
-            toks, lps, top_ids, top_lps = (np.asarray(y) for y in pending.ys)
+            toks, lps, top_ids, top_lps = (np.asarray(y) for y in pending.ys)  # dynlint: sync-point(prefill consume)
         else:
-            toks = np.asarray(pending.ys[0])
+            toks = np.asarray(pending.ys[0])  # dynlint: sync-point(prefill consume)
         if self.profiler is not None:
             self.profiler.consume("prefill", pending.dispatched_at)
         if self.flight is not None:
@@ -1795,11 +1838,11 @@ class TPUEngine(AsyncEngine):
         exactly as in decode."""
         if pending.want_lp:
             targets, n_emits, lps, top_ids, top_lps = (
-                np.asarray(y) for y in pending.ys
+                np.asarray(y) for y in pending.ys  # dynlint: sync-point(spec verify consume)
             )
         else:
-            targets = np.asarray(pending.ys[0])
-            n_emits = np.asarray(pending.ys[1])
+            targets = np.asarray(pending.ys[0])  # dynlint: sync-point(spec verify consume)
+            n_emits = np.asarray(pending.ys[1])  # dynlint: sync-point(spec verify consume)
         if self.profiler is not None:
             self.profiler.consume("spec_verify", pending.dispatched_at)
         if self.flight is not None:
@@ -2083,7 +2126,7 @@ class TPUEngine(AsyncEngine):
             )
             stepped.append((seq, min(K, cap - wpos + 1), r))
         n_variants = len(self._decode_fns)
-        fn = self._decode_fn(
+        fn = self._decode_fn(  # dynlint: recompile-hazard(chained window reuses the dispatched bucket)
             rows,
             cfg.page_bucket_for(max_pages),
             pending.full_sampler,
@@ -2153,10 +2196,10 @@ class TPUEngine(AsyncEngine):
         K = self.cfg.decode_window
         if pending.want_lp:
             sampled, lps, top_ids, top_lps = (
-                np.asarray(y) for y in pending.ys
+                np.asarray(y) for y in pending.ys  # dynlint: sync-point(decode window consume)
             )
         else:
-            sampled = np.asarray(pending.ys[0])
+            sampled = np.asarray(pending.ys[0])  # dynlint: sync-point(decode window consume)
         if self.profiler is not None:
             # The np.asarray above was this window's one host sync.
             self.profiler.consume("decode", pending.dispatched_at)

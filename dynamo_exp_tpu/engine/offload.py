@@ -169,7 +169,7 @@ class CopyStream:
                 if item is None:
                     return
                 seq_hashes, k_dev, v_dev, on_synced = item
-                k_np, v_np = np.asarray(k_dev), np.asarray(v_dev)
+                k_np, v_np = np.asarray(k_dev), np.asarray(v_dev)  # dynlint: sync-point(offload copy-thread transfer)
                 if on_synced is not None:
                     try:
                         on_synced()

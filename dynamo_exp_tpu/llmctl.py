@@ -212,6 +212,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="list the file's dump blocks instead of rendering one",
     )
 
+    # Offline static analysis (docs/static_analysis.md): run the
+    # dynlint AST invariant checkers (host-sync / determinism /
+    # thread-ownership / recompile-hazard) over the package tree.
+    # `--rule` and `--baseline` support incremental adoption during
+    # large refactors; `make lint` and the tier-1 gate run the full
+    # zero-unwaived-findings check.
+    lint = sub.add_parser(
+        "lint", help="dynlint: AST invariant checks (offline)"
+    )
+    from .analysis.runner import add_lint_args
+
+    add_lint_args(lint)
+
     # Offline cluster simulation (docs/simulation.md): replay a seeded
     # workload through the real admission/routing/preemption/planner
     # policy code against modeled instances and print the SimReport.
@@ -449,6 +462,10 @@ async def run(args) -> int:
         return run_flight(args)
     if args.plane == "sim":  # offline: modeled fleet, no cluster
         return run_sim(args)
+    if args.plane == "lint":  # offline: AST checks, no cluster
+        from .analysis.runner import run_cli
+
+        return run_cli(args)
     if not args.coordinator:
         print("--coordinator is required for this command", file=sys.stderr)
         return 2
