@@ -57,6 +57,11 @@ CPU_SWEEP_CONCURRENCY = (1, 2, 4)
 CPU_SWEEP_KW = dict(slots=4, isl=128, osl=32)  # occupancy/overload sweeps
 CPU_OVERLOAD_BURSTS = (4, 8, 16)
 CPU_PREFIX_KW = dict(isl=256, osl=8, concurrency=4)
+# Prefix-sharing sweep CPU fallback: same trim treatment — tiny shapes,
+# two ratio points, enough to exercise shared-vs-private both arms.
+CPU_PREFIX_SWEEP_KW = dict(
+    isl=128, osl=8, concurrency=4, ratios=(0.0, 0.75)
+)
 # Spec-sweep CPU fallback: same trimming policy as every other sweep —
 # tiny shapes, one draft length besides the off baseline.
 CPU_SPEC_KW = dict(slots=2, isl=96, osl=32, draft_lens=(0, 4))
@@ -673,6 +678,151 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
     }
 
 
+def run_prefix_sweep(
+    isl: int = 1024,
+    osl: int = 32,
+    concurrency: int = 8,
+    ratios: tuple = (0.0, 0.5, 0.875),
+) -> list:
+    """Fleet-wide prefix sharing vs the private-copy baseline
+    (docs/prefix_sharing.md) across a shared-prefix ratio axis.
+
+    Each point fires one *concurrent* burst of ``concurrency`` requests
+    whose prompts share the first ``ratio * isl`` tokens — the
+    many-users-one-system-prompt shape — against a sharing engine and a
+    ``prefix_sharing=False`` baseline, and reports HBM pages per request
+    (resident-page high-water mark / requests), p50 TTFT, and the
+    page-granular prefix-hit breakdown. Concurrent admission is the
+    point: sharing must collapse pages even when every request arrives
+    before the first one has prefilled (pending-fill attach).
+    """
+    import asyncio
+
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    _enable_compile_cache()
+    mcfg = _preset(MODEL)
+    ps = 16
+
+    def build_engine(sharing: bool) -> TPUEngine:
+        cfg = EngineConfig(
+            model=mcfg,
+            max_decode_slots=concurrency,
+            page_size=ps,
+            # Sized for the PRIVATE worst case so the baseline arm
+            # measures pages, not preemption thrash.
+            num_pages=concurrency * ((isl + osl) // ps + 2) + 64,
+            max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
+            eos_token_ids=[],
+            kv_dtype=_kv_dtype(),
+            decode_window=8,
+            prefix_sharing=sharing,
+        )
+        eng = TPUEngine(cfg, seed=0)
+        eng.start()
+        return eng
+
+    async def run_one(engine, prompt):
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = osl
+        b.stop_conditions.ignore_eos = True
+        t0 = time.perf_counter()
+        stream = await engine.generate(b.to_dict())
+        ttft = None
+        async for item in stream:
+            if item.get("token_ids") and ttft is None:
+                ttft = time.perf_counter() - t0
+        return ttft
+
+    async def burst(engine, prompts):
+        return await asyncio.gather(*[run_one(engine, p) for p in prompts])
+
+    def arm(engine, warm_prompts, prompts) -> dict:
+        # Per-ratio warm burst: a shared-prefix burst exercises suffix-
+        # length prefill buckets the full-prompt warmup never compiled —
+        # TTFT must measure steady state, not variant compiles. The warm
+        # burst uses its own prefix, so the measured burst's hit counts
+        # stay cold/honest.
+        asyncio.run(burst(engine, warm_prompts))
+        # High-water marks measured per burst: rebase to the quiesced
+        # pool (previous bursts' pages are parked, not active/shared).
+        engine.kv.peak_active_pages = engine.kv.active_pages
+        engine.kv.peak_shared_pages = engine.kv.live_shared
+        hits0 = dict(engine.kv.prefix_hits)
+        cow0 = engine.kv.cow_copies
+        ttfts = sorted(
+            t for t in asyncio.run(burst(engine, prompts)) if t is not None
+        )
+        m = engine.metrics()
+        return {
+            "pages_per_request": round(
+                engine.kv.peak_active_pages / max(len(prompts), 1), 2
+            ),
+            "p50_ttft_s": round(ttfts[len(ttfts) // 2], 3),
+            "prefix_hits": {
+                k: m[f"kv_prefix_hits_{k}"] - hits0[k]
+                for k in ("shared", "restore", "miss")
+            },
+            "cow_copies": m["kv_cow_copies"] - cow0,
+            "shared_pages_peak": engine.kv.peak_shared_pages,
+        }
+
+    rs = np.random.RandomState(0)
+    shared_eng = build_engine(True)
+    private_eng = build_engine(False)
+    out = []
+    # Compile warmup on both arms (distinct prompts: no sharing yet).
+    warm = [
+        rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
+        for _ in range(concurrency)
+    ]
+    for eng in (shared_eng, private_eng):
+        for _ in range(WARMUP_BURSTS):
+            asyncio.run(burst(eng, warm))
+    for ratio in ratios:
+        prefix_len = int(isl * ratio) // ps * ps
+
+        def ratio_prompts() -> list:
+            prefix = rs.randint(
+                10, mcfg.vocab_size - 10, size=prefix_len
+            ).tolist()
+            return [
+                prefix
+                + rs.randint(
+                    10, mcfg.vocab_size - 10, size=isl - prefix_len
+                ).tolist()
+                for _ in range(concurrency)
+            ]
+
+        warm_prompts, prompts = ratio_prompts(), ratio_prompts()
+        shared = arm(shared_eng, warm_prompts, prompts)
+        private = arm(private_eng, warm_prompts, prompts)
+        out.append(
+            {
+                "metric": (
+                    f"prefix_sweep_{MODEL}_isl{isl}_c{concurrency}"
+                    f"_r{ratio}"
+                ),
+                "value": shared["pages_per_request"],
+                "unit": "pages/request",
+                "shared_prefix_ratio": ratio,
+                "vs_baseline": round(
+                    shared["pages_per_request"]
+                    / max(private["pages_per_request"], 1e-9),
+                    4,
+                ),
+                "shared": shared,
+                "private": private,
+                "decode_window": shared_eng.cfg.decode_window,
+                "dispatch": _dispatch_stats(shared_eng),
+            }
+        )
+    shared_eng.stop()
+    private_eng.stop()
+    return out
+
+
 def _fall_back_to_cpu(reason: str) -> str:
     """Pin this process (and its children) to the XLA CPU backend.
     Env var for anything imported later, config update in case a
@@ -768,6 +918,12 @@ def main() -> None:
         "lengths {0,2,4,8} on prefix-repetitive vs random workloads",
     )
     ap.add_argument(
+        "--prefix-sweep",
+        action="store_true",
+        help="HBM pages/request, TTFT, and prefix-hit breakdown across "
+        "a shared-prefix ratio axis, sharing vs private-copy baseline",
+    )
+    ap.add_argument(
         "--model",
         default=None,
         help=f"preset name (default {MODEL}; {CPU_MODEL} on CPU fallback)",
@@ -813,6 +969,9 @@ def main() -> None:
             emit(point)
     elif args.spec_sweep:
         for point in run_spec_sweep(**(CPU_SPEC_KW if cpu else {})):
+            emit(point)
+    elif args.prefix_sweep:
+        for point in run_prefix_sweep(**(CPU_PREFIX_SWEEP_KW if cpu else {})):
             emit(point)
     elif args.prefix_reuse:
         emit(run_prefix_reuse(**(CPU_PREFIX_KW if cpu else {})))

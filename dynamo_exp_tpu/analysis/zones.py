@@ -26,6 +26,10 @@ HOT_PATH_ZONES: tuple[Zone, ...] = (
     Zone("dynamo_exp_tpu/engine/scheduler.py"),
     Zone("dynamo_exp_tpu/engine/offload.py"),
     Zone("dynamo_exp_tpu/engine/kv_manager.py"),
+    # The prefix-sharing radix index runs inside allocate_sequence /
+    # register_full_page on the loop thread — pure host bookkeeping,
+    # and it must stay that way.
+    Zone("dynamo_exp_tpu/kv/prefix.py"),
     # The profiler's whole contract is "zero added host syncs"
     # (docs/observability.md); the checker turns that claim into a
     # standing property instead of one driven smoke test.
@@ -59,6 +63,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
             "generate",  # asyncio ingress
             "prefill_extract",  # asyncio ingress (disagg prefill)
             "confirm_kv_lease",  # prefill worker's delivery ack thread
+            "pin_prefix",  # disagg router's suffix-transfer pin (asyncio)
             "start",
             "stop",
             "metrics",  # /metrics scrapes from serving threads
@@ -93,6 +98,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_last_move_t",
                 "_last_gauge_pub",
                 "_last_reap",
+                "_pub_prefix_hits",  # gauge-publish counter snapshots
             }
         ),
         handoff=frozenset(
@@ -100,6 +106,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 # Queues/events other threads feed the loop through.
                 "_submit_q",
                 "_lease_confirm_q",
+                "_pin_q",
                 "_wake",
                 # Lifecycle flags/threads, written only before the loop
                 # starts or after it is joined.
@@ -118,6 +125,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_seed_rng",  # submission-side only (asyncio threads)
                 "_gather_pages",
                 "_inject_pages",
+                "_cow_pages",
                 "_init_row",
                 "_attn_impl",
                 "_attn_interpret",

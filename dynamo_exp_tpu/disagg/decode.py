@@ -54,6 +54,9 @@ class DisaggDecodeEngine(AsyncEngine):
         self.remote_prefills = 0  # metrics
         self.local_fallbacks = 0
         self.queue_probe_failures = 0
+        # Suffix-only transfers: prompt blocks NOT shipped because this
+        # decode worker already held them (docs/prefix_sharing.md).
+        self.blocks_skipped = 0
 
     async def generate(
         self, request: dict | BackendInput, context: AsyncEngineContext | None = None
@@ -120,10 +123,23 @@ class DisaggDecodeEngine(AsyncEngine):
         remaining = ctx.time_remaining()
         if remaining is not None:
             timeout = min(timeout, max(remaining, 0.0))
+        # Suffix-only transfer (docs/prefix_sharing.md): pin the locally
+        # resident shared prefix so the wire ships only the unshared
+        # suffix. The pin (a KV lease) keeps those pages resident until
+        # admission re-references them; at least the last page always
+        # ships (it carries the partial tail + proves the worker ran).
+        need_pages = -(-len(binput.token_ids) // self.engine.cfg.page_size)
+        skip, pin_lease = 0, None
+        try:
+            skip, pin_lease = await self.engine.pin_prefix(binput.token_ids)
+        except Exception:  # noqa: BLE001 - the pin is an optimization
+            logger.warning("prefix pin failed; full transfer", exc_info=True)
+        skip = min(skip, max(need_pages - 1, 0))
         with trace_span(
             "remote_prefill",
             request_id=rid,
             prompt_tokens=len(binput.token_ids),
+            skipped_blocks=skip or None,
             # Failover continuation (prompt + journaled tokens being
             # re-prefilled) — visible in `llmctl trace` as the re-prefill
             # hop's remote leg.
@@ -144,23 +160,36 @@ class DisaggDecodeEngine(AsyncEngine):
                 trace_id=sp.context.trace_id,
                 parent_span_id=sp.context.span_id,
                 deadline_unix=ctx.deadline or 0.0,
+                skip_blocks=skip,
             )
             try:
                 await self.queue.push(req.to_bytes())
                 first_token, pages = await asyncio.wait_for(
                     fut, timeout=timeout
                 )
-                self._check_page_shapes(pages, len(binput.token_ids))
+                skip_used = self._check_page_shapes(
+                    pages, len(binput.token_ids), skip
+                )
                 self.remote_prefills += 1
+                self.blocks_skipped += skip_used
                 self.breaker.record_success()
-                sp.set(outcome="remote")
-                return RemoteKv(first_token=first_token, pages=pages)
+                sp.set(outcome="remote", skipped_blocks=skip_used or None)
+                return RemoteKv(
+                    first_token=first_token,
+                    pages=pages,
+                    skip_pages=skip_used,
+                    pin_lease=pin_lease,
+                )
             except Exception:  # noqa: BLE001 - remote prefill is best-effort
                 logger.exception(
                     "remote prefill failed for %s; prefilling locally", rid
                 )
                 self.receiver.forget(rid)
                 self.local_fallbacks += 1
+                if pin_lease:
+                    # Local prefill will re-match (or recompute) the
+                    # prefix itself; release the routing-time pin.
+                    self.engine.confirm_kv_lease(pin_lease)
                 # A wait cut short by the *request's own deadline* says
                 # nothing about fleet health — only count fleet-attributable
                 # failures toward the breaker, or three short-deadline
@@ -175,15 +204,35 @@ class DisaggDecodeEngine(AsyncEngine):
                     self.breaker.release()
                 sp.set(outcome="local_fallback")
                 return None
+            except BaseException:
+                # Cancellation (client disconnect / deadline) must not
+                # strand the suffix-transfer pin until the lease TTL —
+                # under a burst of cancelled long-prefix requests that
+                # transiently shrinks the decode pool for live work.
+                self.receiver.forget(rid)
+                if pin_lease:
+                    self.engine.confirm_kv_lease(pin_lease)
+                raise
 
-    def _check_page_shapes(self, pages: list, prompt_len: int) -> None:
+    def _check_page_shapes(
+        self, pages: list, prompt_len: int, skip: int = 0
+    ) -> int:
         """Last line of defense: a wrong-shaped or short transfer must
         fall back to local prefill here, not leave uninitialized device
-        pages that decode silently attends over."""
+        pages that decode silently attends over. Returns the skip the
+        sender actually honored: a full-length reply (older worker that
+        ignores ``skip_blocks``) is accepted as skip 0."""
         cfg = self.engine.cfg
         need = (prompt_len + cfg.page_size - 1) // cfg.page_size
-        if len(pages) != need:
-            raise ValueError(f"got {len(pages)} KV pages, expected {need}")
+        if len(pages) == need:
+            skip_used = 0  # full transfer (skip ignored or 0)
+        elif skip and len(pages) == need - skip:
+            skip_used = skip  # suffix-only transfer
+        else:
+            raise ValueError(
+                f"got {len(pages)} KV pages, expected {need} "
+                f"(or {need - skip} with skip_blocks={skip})"
+            )
         expected = (
             cfg.model.num_layers,
             cfg.page_size,
@@ -194,11 +243,13 @@ class DisaggDecodeEngine(AsyncEngine):
                 raise ValueError(
                     f"KV page shape {tuple(k.shape)} != expected {expected}"
                 )
+        return skip_used
 
     def metrics(self) -> dict:
         m = self.engine.metrics()
         m["disagg_remote_prefills"] = self.remote_prefills
         m["disagg_local_fallbacks"] = self.local_fallbacks
         m["disagg_queue_probe_failures"] = self.queue_probe_failures
+        m["disagg_blocks_skipped"] = self.blocks_skipped
         m["disagg_breaker_state"] = self.breaker.state.value
         return m

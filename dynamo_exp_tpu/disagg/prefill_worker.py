@@ -116,8 +116,14 @@ class PrefillWorker:
                     token_ids=req.token_ids,
                     sampling_options=SamplingOptions(**req.sampling_options),
                 )
-                first_token, pages, lease_id = await self.engine.prefill_extract(
-                    binput
+                skip = max(int(req.skip_blocks or 0), 0)
+                # Keyword only when the decode side asked for a suffix:
+                # duck-typed engine stubs (and older engines) that don't
+                # know skip_pages keep working for full transfers.
+                first_token, pages, lease_id = await (
+                    self.engine.prefill_extract(binput, skip_pages=skip)
+                    if skip
+                    else self.engine.prefill_extract(binput)
                 )
             except Exception as e:  # noqa: BLE001 - report upstream, keep serving
                 logger.exception("prefill failed for %s", req.request_id)

@@ -56,6 +56,12 @@ class RemotePrefillRequest:
     # latency this bound must cover; decode and prefill hosts share a
     # clock discipline (same pod).
     deadline_unix: float = 0.0
+    # Suffix-only KV transfer (docs/prefix_sharing.md): leading prompt
+    # pages the decode worker already holds (pinned resident there) —
+    # the prefill worker neither gathers nor ships them. An older
+    # worker ignores the field and ships everything; the decode side
+    # detects the full-length reply and injects from page 0.
+    skip_blocks: int = 0
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()

@@ -77,6 +77,14 @@ class EngineConfig:
     host_cache_pages: int = 0
     # Emit KV stored/removed events for the router index.
     enable_kv_events: bool = True
+    # Fleet-wide prefix sharing (docs/prefix_sharing.md): refcounted
+    # copy-on-write KV pages behind the radix prefix index — admissions
+    # attach resident (even still-filling) shared prefix pages and
+    # prefill only the unshared suffix. False is the private-copy
+    # baseline: every admission materializes its own pages (bench.py
+    # --prefix-sweep's comparison arm; identity tests prove the token
+    # streams are equal either way).
+    prefix_sharing: bool = True
     # KV-pressure preemption (docs/fault_tolerance.md "Overload
     # protection"): when the page pool is dry and an ACTIVE row has been
     # hard-stalled (cannot feed its next token) longer than this grace,

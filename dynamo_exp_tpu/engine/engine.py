@@ -219,6 +219,7 @@ class TPUEngine(AsyncEngine):
             event_cb=kv_event_cb if cfg.enable_kv_events else None,
             host_pool=self.host_pool,
             on_evict=on_evict,
+            sharing=cfg.prefix_sharing,
         )
         # Observability (docs/observability.md): per-dispatch profiler
         # (host gap vs in-flight, compile attribution — pure timestamps
@@ -248,6 +249,17 @@ class TPUEngine(AsyncEngine):
             lambda k, v, pids, hk, hv: (
                 k.at[:, pids].set(hk),
                 v.at[:, pids].set(hv),
+            ),
+            donate_argnums=(0, 1),
+        )
+        # Copy-on-write page copy (docs/prefix_sharing.md): device-to-
+        # device duplicate of one shared page before its first divergent
+        # write. Indices ride as traced device scalars, so every COW
+        # shares ONE compiled variant.
+        self._cow_pages = jax.jit(
+            lambda k, v, src, dst: (
+                k.at[:, dst].set(k[:, src]),
+                v.at[:, dst].set(v[:, src]),
             ),
             donate_argnums=(0, 1),
         )
@@ -329,6 +341,16 @@ class TPUEngine(AsyncEngine):
         # single-writer — queue them for the loop thread, which also
         # runs the expiry reaper each iteration.
         self._lease_confirm_q: queue.Queue[str] = queue.Queue()
+        # Prefix pin requests (disagg suffix-only transfer): the decode
+        # router asks "how much of this prompt do you already hold?" and
+        # pins the answer under a lease. Served on the loop thread (the
+        # manager's single writer); results travel back via futures.
+        self._pin_q: queue.Queue[tuple] = queue.Queue()
+        # Telemetry counter snapshot (prefix sharing): the prometheus
+        # prefix-hit mirror advances by delta at gauge-publish time (the
+        # page manager itself is telemetry-free; COW has its own event-
+        # site counter in _resolve_shared_tail).
+        self._pub_prefix_hits = {"shared": 0, "restore": 0, "miss": 0}
 
     # ----------------------------------------------------------- compiled fns
     def _resolve_attn(self) -> tuple[str, bool]:
@@ -733,6 +755,9 @@ class TPUEngine(AsyncEngine):
                 return
             self._thread = None
         self._inflight = None  # dynlint: thread-ownership(loop thread joined before teardown flush)
+        # Prefix-pin requests queued after the loop's last service pass
+        # must not hang their callers (disagg routing awaits them).
+        self._drain_pin_q()
         if self.copy_stream is not None:
             # Flush evictions the dead loop buffered, then drain
             # (bounded) so a graceful drain doesn't silently discard
@@ -827,18 +852,23 @@ class TPUEngine(AsyncEngine):
         self,
         request: dict | BackendInput,
         context: AsyncEngineContext | None = None,
+        skip_pages: int = 0,
     ) -> tuple[int, list, str]:
         """Run prefill only; hand back (first_token, kv_pages, lease_id).
 
         This is the prefill-worker side of disaggregation: the prompt's
         KV pages (host-bounced numpy, one (k, v) pair per page) travel to
         the decode worker, which injects them via ``generate(...,
-        remote_kv=...)``. The pages also stay registered locally, so
-        repeated prompts prefix-hit this worker's pool. Until the caller
-        confirms delivery (:meth:`confirm_kv_lease`) — or the lease TTL
-        passes and the reaper reclaims them — the device pages stay
-        pinned, so a decode worker that dies between extract and inject
-        can never strand HBM.
+        remote_kv=...)``. ``skip_pages`` is the decode side's pinned
+        resident prefix (suffix-only transfer, docs/prefix_sharing.md):
+        those pages are neither gathered nor shipped — the full prompt
+        is still prefilled locally (so this worker's pool prefix-hits
+        repeats), but the wire and the extract gather carry only the
+        unshared suffix. Until the caller confirms delivery
+        (:meth:`confirm_kv_lease`) — or the lease TTL passes and the
+        reaper reclaims them — the shipped device pages stay pinned, so
+        a decode worker that dies between extract and inject can never
+        strand HBM.
         """
         if not self._running:
             self.start()
@@ -878,6 +908,7 @@ class TPUEngine(AsyncEngine):
             emit=emit,
             is_cancelled=lambda: ctx.is_stopped,
             extract_cb=extract_cb,
+            extract_skip=max(int(skip_pages), 0),
             trace=current_trace(),
             submitted_at=time.time(),
             sample_seed=self._effective_seed(binput),
@@ -893,6 +924,65 @@ class TPUEngine(AsyncEngine):
         confirm for the engine loop, the page manager's single writer)."""
         self._lease_confirm_q.put(lease_id)
         self._wake.set()
+
+    async def pin_prefix(self, token_ids: list[int]) -> tuple[int, str | None]:
+        """How many full prompt pages this engine already holds — pinned.
+
+        The disagg decode router calls this before offloading a prefill:
+        the answer becomes the request's ``skip_blocks`` (the prefill
+        worker ships only the unshared suffix), and the returned lease
+        keeps the matched pages resident until admission re-references
+        them (the engine confirms the lease at inject; the reaper is the
+        TTL backstop). Thread-safe: the match + pin run on the engine
+        loop, the page manager's single writer. Returns ``(0, None)``
+        when the engine is not running, sharing is disabled, or it
+        holds nothing."""
+        if not self._running or not self.kv.sharing:
+            # A prefix_sharing=False engine never re-attaches at
+            # admission, so a skip would discard the whole transfer.
+            return (0, None)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pin_q.put((list(token_ids), loop, fut))
+        self._wake.set()
+        if not self._running and not fut.done():
+            # stop() drained the queue before our put landed: nothing
+            # will ever service this entry — resolve it ourselves (the
+            # done() guards make a racing resolver a no-op).
+            fut.set_result((0, None))
+        return await fut
+
+    def _service_pins(self) -> None:
+        """Engine-loop side of :meth:`pin_prefix`: match the resident
+        *filled* prefix (bytes that exist on device now) and pin it."""
+        while True:
+            try:
+                tokens, loop, fut = self._pin_q.get_nowait()
+            except queue.Empty:
+                return
+            pages, _ = self.kv.match_prefix(tokens, require_filled=True)
+            lease = (
+                self.kv.grant_lease(pages, self.cfg.kv_lease_ttl_s)
+                if pages
+                else None
+            )
+            result = (len(pages), lease)
+
+            def resolve(f=fut, r=result, lease=lease):
+                # Runs on the caller's event loop. A future already done
+                # (cancelled request) can never hand the lease back —
+                # release the pin instead of waiting out its TTL.
+                if f.done():
+                    if lease is not None:
+                        self.confirm_kv_lease(lease)
+                else:
+                    f.set_result(r)
+
+            try:
+                loop.call_soon_threadsafe(resolve)
+            except RuntimeError:  # caller's loop closed: release the pin
+                if lease is not None:
+                    self.kv.confirm_lease(lease)
 
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
@@ -919,6 +1009,7 @@ class TPUEngine(AsyncEngine):
                 # mutate the page manager, so they run here — its single
                 # writer — every iteration, busy or idle.
                 self._service_leases()
+                self._service_pins()
                 if self._inflight is not None:
                     # Steady state: launch the next window device-to-
                     # device, then consume the previous one while the
@@ -980,9 +1071,14 @@ class TPUEngine(AsyncEngine):
                 ]
                 # Partition the snapshot BEFORE injecting: injection
                 # clears remote_kv and promotes the sequence to ACTIVE,
-                # so filtering afterwards would re-prefill it.
-                batch = [s for s in prefilling if s.remote_kv is None]
-                for seq in prefilling:
+                # so filtering afterwards would re-prefill it. Sequences
+                # attached to shared pages another sequence is still
+                # filling sit out until those fills are dispatched
+                # (fill_ready also claims orphans left by dead fillers)
+                # — device stream order then makes their reads safe.
+                ready = [s for s in prefilling if self.sched.fill_ready(s)]
+                batch = [s for s in ready if s.remote_kv is None]
+                for seq in ready:
                     if seq.remote_kv is not None:
                         self._run_remote_inject(seq)
                         progressed = True
@@ -1116,7 +1212,15 @@ class TPUEngine(AsyncEngine):
         now = time.monotonic()
         if now - self._last_gauge_pub >= 0.5:
             self._last_gauge_pub = now
-            get_telemetry().publish_engine_gauges(self.metrics())
+            tel = get_telemetry()
+            tel.publish_engine_gauges(self.metrics())
+            # Prefix-hit counters advance by delta (the page manager is
+            # telemetry-free; its in-object counters are authoritative).
+            for kind, total in self.kv.prefix_hits.items():
+                delta = total - self._pub_prefix_hits[kind]
+                if delta:
+                    tel.kv_prefix_hits.labels(kind).inc(delta)
+                    self._pub_prefix_hits[kind] = total
 
     def _service_leases(self) -> None:
         """Engine-loop-thread lease upkeep: apply queued delivery
@@ -1236,6 +1340,23 @@ class TPUEngine(AsyncEngine):
                 self._submit_q.get_nowait().emit([], FinishReason.ERROR)
             except queue.Empty:
                 break
+        self._drain_pin_q()
+
+    def _drain_pin_q(self) -> None:
+        """Resolve every queued prefix-pin request with the no-coverage
+        answer — callers await these futures unboundedly, so shutdown
+        and crash paths must never strand one."""
+        while not self._pin_q.empty():
+            try:
+                _tokens, loop, fut = self._pin_q.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                loop.call_soon_threadsafe(
+                    lambda f=fut: f.done() or f.set_result((0, None))
+                )
+            except RuntimeError:
+                pass
 
     # ----------------------------------------------------- batched page moves
     def _gather_page_batch(self, pids: list[int], kind: str = "kv_move"):
@@ -1336,12 +1457,16 @@ class TPUEngine(AsyncEngine):
         scatter per sequence, not one per page."""
         if not seq.pending_uploads:
             return
+        upload_pids = [pid for pid, _h, _k, _v in seq.pending_uploads]
         self._inject_page_batch(
-            [pid for pid, _h, _k, _v in seq.pending_uploads],
+            upload_pids,
             [hk for _pid, _h, hk, _v in seq.pending_uploads],
             [hv for _pid, _h, _k, hv in seq.pending_uploads],
             op="upload",
         )
+        # Content is on the stream: sharers waiting on these restored
+        # pages can dispatch behind it.
+        self.kv.mark_filled(upload_pids)
         seq.pending_uploads = []
 
     @staticmethod
@@ -1432,7 +1557,8 @@ class TPUEngine(AsyncEngine):
         reaper reclaims them."""
         ps = self.cfg.page_size
         n_pages = (len(seq.prompt) + ps - 1) // ps
-        pids = seq.page_ids[:n_pages]
+        skip = min(seq.extract_skip, n_pages)
+        pids = seq.page_ids[skip:n_pages]
         if not pids:
             return [], ""
         k_b, v_b = self._gather_page_batch(pids)
@@ -1460,20 +1586,42 @@ class TPUEngine(AsyncEngine):
     def _run_remote_inject(self, seq: Sequence) -> None:
         """Disaggregated admission: prompt KV was computed by a remote
         prefill worker — inject it (one batched scatter) and go straight
-        to decode."""
+        to decode. Suffix-only transfers (docs/prefix_sharing.md) ship
+        ``rk.pages`` starting at prompt page ``rk.skip_pages``; the
+        decode-side pin that guaranteed those first pages stayed
+        resident is released here."""
         self._apply_uploads(seq)
         ps = self.cfg.page_size
         rk = seq.remote_kv
+        if rk.pin_lease:
+            # Admission re-referenced the pinned pages (or is about to
+            # fall back); either way the routing-time pin has done its
+            # job. The sequence's own refs keep the pages alive now.
+            self.kv.confirm_lease(rk.pin_lease)
+            rk.pin_lease = None
         n_pages = (len(seq.prompt) + ps - 1) // ps
-        start = seq.cached_len // ps  # locally matched/uploaded prefix
-        end = min(n_pages, len(rk.pages))
+        if rk.skip_pages and seq.cached_len // ps < rk.skip_pages:
+            # The local prefix the transfer skipped is no longer fully
+            # resident (pin reaped under an extreme queue wait): the
+            # received suffix is useless without it. Fall back to local
+            # prefill — the sequence simply stays in PREFILL.
+            log.warning(
+                "request %s: suffix-only KV transfer skipped %d pages "
+                "but only %d are resident; prefilling locally",
+                seq.request_id, rk.skip_pages, seq.cached_len // ps,
+            )
+            seq.remote_kv = None
+            return
+        start = max(seq.cached_len // ps, rk.skip_pages)
+        end = min(n_pages, rk.skip_pages + len(rk.pages))
         if end > start:
             self._inject_page_batch(
                 seq.page_ids[start:end],
-                [rk.pages[i][0] for i in range(start, end)],
-                [rk.pages[i][1] for i in range(start, end)],
+                [rk.pages[i - rk.skip_pages][0] for i in range(start, end)],
+                [rk.pages[i - rk.skip_pages][1] for i in range(start, end)],
                 op="inject",
             )
+            self.kv.mark_filled(seq.page_ids[start:end])
         seq.remote_kv = None  # drop the host copy the moment it's injected
         seq.remote_prefilled = True
         self._finish_first_token(seq, rk.first_token)
@@ -1557,6 +1705,17 @@ class TPUEngine(AsyncEngine):
                 tokens=int(sum(sizes)),
                 completing=len(completed),
             )
+        # Pages this chunk fully covered are now filled *in dispatch
+        # order*: sharers gated on them may dispatch reads from the next
+        # iteration on (prefix sharing, docs/prefix_sharing.md).
+        newly_filled: list[int] = []
+        for seq in batch:
+            n_full = seq.prefill_sent // ps
+            if n_full > seq.fill_marked:
+                newly_filled.extend(seq.page_ids[seq.fill_marked : n_full])
+                seq.fill_marked = n_full
+        if newly_filled:
+            self.kv.mark_filled(newly_filled)
         return _PendingPrefill(
             ys=ys,
             completed=completed,
@@ -1628,6 +1787,44 @@ class TPUEngine(AsyncEngine):
         stops = list(self.cfg.eos_token_ids) + list(sc.stop_token_ids)
         return stops[: self.cfg.device_stop_width]
 
+    def _resolve_shared_tail(self, seq: Sequence) -> bool:
+        """Copy-on-write before the first divergent write: the row's
+        next decode token lands inside a page it attached read-shared
+        (radix partial-tail match). Sole holder ⇒ the page just leaves
+        the index (content offloads to G2 first); shared ⇒ allocate a
+        replacement and duplicate it device-to-device — ONE dispatch,
+        stream-ordered ahead of the decode window that diverges it.
+        False when the pool can't supply the copy target (hard stall)."""
+        pid = seq.shared_tail_pid
+        new_pid = self.kv.make_private(pid)
+        if new_pid is None:
+            return False
+        if new_pid != pid:
+            idx = seq.page_ids.index(pid)
+            self._flush_offloads()
+            prof = self.profiler
+            if prof is not None:
+                fresh = prof.first_variant("cow", 0)
+                t0 = prof.begin("kv_move")
+            self.k_cache, self.v_cache = self._cow_pages(
+                self.k_cache,
+                self.v_cache,
+                jnp.asarray(pid, jnp.int32),
+                jnp.asarray(new_pid, jnp.int32),
+            )
+            if prof is not None:
+                prof.end("kv_move", t0, fresh)
+            seq.page_ids[idx] = new_pid
+            self.kv.release_sequence([pid])
+            self.kv_page_moves += 1
+            self.kv_move_dispatches += 1
+            get_telemetry().kv_page_moves.labels("cow").inc()
+            get_telemetry().kv_cow_copies.inc()
+            if self.flight is not None:
+                self.flight.record("cow", req=seq.request_id, slot=seq.slot)
+        seq.shared_tail_pid = -1
+        return True
+
     def _dispatch_decode(
         self,
     ) -> tuple[list[_PendingDecode], list[_PendingSpec]]:
@@ -1647,6 +1844,19 @@ class TPUEngine(AsyncEngine):
         sampler: list[tuple[Sequence, int, int]] = []
         for seq in self.sched.slots:
             if seq is None or seq.state is not SeqState.ACTIVE:
+                continue
+            if seq.shared_tail_pid >= 0 and not self._resolve_shared_tail(seq):
+                # The shared tail page must be private before this row's
+                # first decode write lands in it, and the COW copy found
+                # the pool dry: hard-stall the row (same grace clock as
+                # a dry page allocation).
+                seq.stalled = True
+                if not seq.stalled_since:
+                    seq.stalled_since = time.time()
+                    if self.flight is not None:
+                        self.flight.record(
+                            "stall_start", req=seq.request_id, slot=seq.slot
+                        )
                 continue
             wpos = len(seq.tokens) - 1  # position of the token being fed
             # Provision the whole window up front (best effort: partial
@@ -2276,6 +2486,16 @@ class TPUEngine(AsyncEngine):
         m["preemptions"] = self.preempted
         m["kv_leases_active"] = self.kv.active_leases
         m["kv_lease_reclaimed_pages"] = self.kv.lease_reclaimed_pages
+        # Fleet-wide prefix sharing (docs/prefix_sharing.md): COW
+        # copies, the resident-page high-water mark, and the page-
+        # granular admission hit breakdown (shared G1 attach / G2
+        # restore / fresh miss); the kv_shared_pages gauge rides in via
+        # kv.gauges() with the other KV-tier gauges.
+        m["kv_cow_copies"] = self.kv.cow_copies
+        m["kv_peak_pages"] = self.kv.peak_active_pages
+        m["kv_prefix_hits_shared"] = self.kv.prefix_hits["shared"]
+        m["kv_prefix_hits_restore"] = self.kv.prefix_hits["restore"]
+        m["kv_prefix_hits_miss"] = self.kv.prefix_hits["miss"]
         m["compiled_decode_variants"] = len(self._decode_fns)
         m["compiled_prefill_variants"] = len(self._prefill_fns)
         # Per-dispatch profiler mirror (docs/observability.md): per-kind

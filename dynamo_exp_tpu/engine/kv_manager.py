@@ -1,4 +1,4 @@
-"""Host-side KV page pool: allocation, content-addressed prefix reuse,
+"""Host-side KV page pool: allocation, content-addressed prefix sharing,
 LRU eviction, and KV event emission.
 
 Capability parity with the reference's KV block manager
@@ -10,10 +10,21 @@ G1/G2 tiers), redesigned for the TPU engine:
   this manager only tracks *ids* — all data movement happens inside the
   jitted forward (writes) or via host offload (``offload.py``).
 - Reuse is content-addressed by the chained sequence hash of each full
-  page (``tokens.py``), so a new request's prompt prefix maps onto pages
-  already resident in HBM; matched pages are ref-counted, and pages whose
-  refs drop to zero park in an LRU from which they can be revived (hit)
-  or evicted (miss → reallocated).
+  page (``tokens.py``), indexed in a radix tree
+  (:class:`~dynamo_exp_tpu.kv.PrefixIndex`), so a new request's prompt
+  prefix maps onto pages already resident in HBM. Matched pages are
+  ref-counted and **shared across live sequences** — a page leaves G1
+  only at refcount zero (docs/prefix_sharing.md).
+- Sharing extends to pages still *being filled*: prompt pages are
+  registered at allocation (``filled=False`` until their prefill chunk
+  is dispatched), so a burst of same-prefix admissions attaches one
+  copy instead of prefilling N. A filler that dies orphans its pending
+  pages; a waiting sharer claims and re-fills them (deterministic
+  forward ⇒ identical content).
+- A prompt ending *inside* a registered block can attach that block as
+  a shared partial tail (radix ``partial_match``); the first divergent
+  write — the sequence's own decode into the shared page — triggers
+  copy-on-write (:meth:`make_private`).
 - Every registered/evicted full page emits a KV event (stored/removed)
   through a callback — the feed for the KV-aware router's radix index
   (reference: ``lib/llm/src/kv_router/publisher.rs:34-139``).
@@ -27,6 +38,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Callable, Sequence
 
+from ..kv import PrefixIndex
 from ..tokens import compute_block_hashes_for_seq
 
 if TYPE_CHECKING:
@@ -38,8 +50,16 @@ if TYPE_CHECKING:
 @dataclass
 class PageRecord:
     page_id: int
-    seq_hash: int | None = None  # None until the page is full + registered
+    seq_hash: int | None = None  # None until the page is registered
     ref_count: int = 0
+    # Content state for allocation-time registration: a prompt page is
+    # registered (matchable) the moment it is allocated, but ``filled``
+    # flips only once the write that materializes it has been
+    # *dispatched* (stream order then protects later readers). While
+    # unfilled, ``filler`` names the request responsible for the write;
+    # a dead filler leaves it "" (orphaned) for a sharer to claim.
+    filled: bool = True
+    filler: str = ""
 
 
 @dataclass
@@ -47,16 +67,27 @@ class Allocation:
     """Result of ``allocate_sequence``.
 
     ``page_ids`` covers ceil(len(tokens)/page_size) pages; ``cached_len``
-    (a multiple of page_size) counts G1-matched plus G2-uploaded pages;
-    ``uploads`` lists (page_id, seq_hash, k_page, v_page) host pages the
-    engine must inject before prefill; ``hashes`` are the chained
-    sequence hashes of every full prompt page (computed once here so the
-    scheduler never rehashes the prompt)."""
+    counts tokens whose KV the sequence need not recompute — G1-matched
+    + G2-uploaded full pages plus a shared partial tail, capped at
+    len(tokens)-1 so prefill always computes the last token's logits;
+    ``cached_pages`` counts the registered full pages among them (the
+    scheduler's hash-chain resume point); ``uploads`` lists
+    (page_id, seq_hash, k_page, v_page) host pages the engine must
+    inject before prefill; ``wait_fill`` lists attached pages another
+    sequence is still filling (the engine defers this sequence's first
+    prefill dispatch until they are filled); ``shared_tail`` is the
+    (page_id, covered_tokens) partial-tail attach, COWed before the
+    first divergent write; ``hashes`` are the chained sequence hashes
+    of every full prompt page (computed once here so the scheduler
+    never rehashes the prompt)."""
 
     page_ids: list[int]
     cached_len: int
     uploads: list
     hashes: list[int]
+    cached_pages: int = 0
+    wait_fill: list[int] = field(default_factory=list)
+    shared_tail: tuple[int, int] | None = None
 
 
 @dataclass
@@ -70,7 +101,10 @@ class KvLease:
     receipt. The lease takes one extra reference per page; delivery
     confirmation (``confirm_lease``) releases it, and the reaper
     (``reap_expired``) reclaims orphans when the decode instance dies
-    between extract and inject — so failover never strands HBM.
+    between extract and inject — so failover never strands HBM. The
+    decode side reuses the same pin for suffix-only transfers: matched
+    local prefix pages stay resident between the routing decision and
+    the admission that re-references them.
 
     State machine (docs/fault_tolerance.md "Resumable streams"):
     GRANTED → CONFIRMED (transfer acked end-to-end) | EXPIRED (reaped).
@@ -107,11 +141,17 @@ class KvPageManager:
         host_pool: "HostKvPool | None" = None,
         on_evict: Callable[[int, int], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
+        sharing: bool = True,
     ):
         self.num_pages = num_pages
         self.page_size = page_size
         self.event_cb = event_cb
         self.clock = clock
+        # Fleet-wide prefix sharing (docs/prefix_sharing.md). False is
+        # the private-copy baseline: no cross-sequence reuse at all —
+        # every admission materializes its own pages (bench.py's
+        # --prefix-sweep comparison arm).
+        self.sharing = sharing
         # G2 tier: evicted device pages are offloaded (via ``on_evict``,
         # which the engine wires to a device gather + CopyStream) and
         # matched back in from ``host_pool`` on later prompts.
@@ -121,8 +161,11 @@ class KvPageManager:
             i: PageRecord(i) for i in range(num_pages)
         }
         self._free: list[int] = list(range(num_pages - 1, -1, -1))
-        # seq_hash -> page_id for every registered full page still resident.
+        # seq_hash -> page_id for every registered full page still
+        # resident, plus the radix index over the same blocks (the
+        # structure the partial-tail lookup and the router share).
         self._by_hash: dict[int, int] = {}
+        self.index = PrefixIndex()
         # Zero-ref registered pages, LRU order (oldest first).
         self._reclaimable: OrderedDict[int, None] = OrderedDict()
         # Disaggregation handoff leases, by lease id (single-writer like
@@ -136,6 +179,17 @@ class KvPageManager:
         # beyond its G1 device match, how many the host tier supplied.
         self.offload_hits = 0
         self.offload_misses = 0
+        # Prefix-sharing counters (docs/prefix_sharing.md): page-granular
+        # hit breakdown at admission, copy-on-write copies, and the
+        # high-water mark of resident pages (bench.py --prefix-sweep
+        # reads pages-per-request off the peak).
+        self.prefix_hits = {"shared": 0, "restore": 0, "miss": 0}
+        self.cow_copies = 0
+        self.peak_active_pages = 0
+        # Incrementally tracked (refcount 1→2 / 2→1 crossings), so the
+        # gauge and the bench's high-water never scan the pool.
+        self.live_shared = 0
+        self.peak_shared_pages = 0
 
     # ---------------------------------------------------------------- stats
     @property
@@ -150,6 +204,11 @@ class KvPageManager:
     def usage(self) -> float:
         return self.active_pages / max(self.num_pages, 1)
 
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently attached by more than one holder."""
+        return self.live_shared
+
     def hit_rate(self) -> float:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
@@ -163,68 +222,99 @@ class KvPageManager:
         return {
             "hbm_page_occupancy": self.usage,
             "offload_hit_rate": self.offload_hit_rate(),
+            "kv_shared_pages": self.shared_pages,
         }
 
+    def _note_active(self) -> None:
+        active = self.active_pages
+        if active > self.peak_active_pages:
+            self.peak_active_pages = active
+
     # ------------------------------------------------------------ allocation
-    def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], list[int]]:
+    def match_prefix(
+        self, tokens: Sequence[int], require_filled: bool = False
+    ) -> tuple[list[int], list[int]]:
         """Longest resident prefix of ``tokens`` in full pages.
 
         Returns (page_ids, seq_hashes) of the matched prefix — does NOT
         take references; call ``allocate_sequence`` to commit.
-        """
+        ``require_filled`` stops the walk at the first page whose
+        content has not been dispatched yet (the disagg pin path must
+        only count bytes that exist)."""
         return self._match_hashes(
-            compute_block_hashes_for_seq(tokens, self.page_size)
+            compute_block_hashes_for_seq(tokens, self.page_size),
+            require_filled=require_filled,
         )
 
-    def _match_hashes(self, hashes: list[int]) -> tuple[list[int], list[int]]:
+    def _match_hashes(
+        self, hashes: list[int], require_filled: bool = False
+    ) -> tuple[list[int], list[int]]:
         pages: list[int] = []
         matched: list[int] = []
-        for h in hashes:
+        for h in self.index.match_hashes(hashes):
             pid = self._by_hash.get(h)
             if pid is None:
+                break  # index/by-hash drift would be a bug; stay safe
+            if require_filled and not self._records[pid].filled:
                 break
             pages.append(pid)
             matched.append(h)
         return pages, matched
 
     def allocate_sequence(
-        self, tokens: Sequence[int], max_pages: int
+        self, tokens: Sequence[int], max_pages: int, request_id: str = ""
     ) -> Allocation | None:
-        """Pages for a new sequence: reuse the longest device-resident
-        (G1) prefix, extend it from the host tier (G2), then fresh pages
-        for the rest of the prompt.
+        """Pages for a new sequence: attach the longest device-resident
+        (G1) shared prefix — including pages still being filled and a
+        partial tail inside a registered block — extend it from the
+        host tier (G2), then fresh pages for the rest of the prompt.
+        Freshly allocated full prompt pages are registered immediately
+        (``filled=False``) so concurrent same-prefix admissions share
+        them instead of re-prefilling.
 
         Returns an ``Allocation`` or None if the pool can't satisfy the
         request right now (caller re-queues).
         """
         ps = self.page_size
-        need_total = (len(tokens) + ps - 1) // ps
+        n_tokens = len(tokens)
+        need_total = (n_tokens + ps - 1) // ps
         if need_total > max_pages:
             return None  # exceeds per-sequence capacity; caller must reject
         hashes = compute_block_hashes_for_seq(tokens, ps)
-        matched_pages, matched_hashes = self._match_hashes(hashes)
+        if self.sharing:
+            matched_pages, matched_hashes = self._match_hashes(hashes)
+        else:
+            matched_pages, matched_hashes = [], []
         # Extend the match into the host tier — match first (no copies);
         # pages are fetched only once the allocation is known to succeed,
         # so a pool-exhausted retry loop never repeats the memcpys.
         g2_hashes: list[int] = []
-        if self.host_pool is not None:
+        if self.sharing and self.host_pool is not None:
             g2_hashes = self.host_pool.match_chain(hashes[len(matched_pages) :])
-        # Never reuse the *entire* prompt: the last token's KV must be
-        # recomputed into a page this sequence owns so decode can append.
-        while (
-            matched_pages or g2_hashes
-        ) and (len(matched_pages) + len(g2_hashes)) * ps >= len(tokens):
-            if g2_hashes:
-                g2_hashes.pop()
-            else:
-                matched_pages.pop()
-                matched_hashes.pop()
-        need_fresh = need_total - len(matched_pages)
+        # Shared partial tail: the prompt ends inside a block some other
+        # sequence registered — attach that page read-shared; the owner
+        # COWs it before its first divergent (decode) write.
+        shared_tail: tuple[int, int] | None = None
+        tail_tokens = tokens[(n_tokens // ps) * ps :]
+        if (
+            self.sharing
+            and tail_tokens
+            and not g2_hashes
+            and len(matched_pages) == n_tokens // ps
+        ):
+            parent = matched_hashes[-1] if matched_hashes else None
+            hit = self.index.partial_match(parent, tail_tokens)
+            if hit is not None:
+                tpid = self._by_hash.get(hit[0])
+                if tpid is not None:
+                    shared_tail = (tpid, hit[1])
+        need_fresh = need_total - len(matched_pages) - (1 if shared_tail else 0)
         # Matched parked pages are about to leave the reclaimable LRU
         # (_ref_page below); counting them as takeable here would let
         # _take_free pop an empty LRU and crash the engine loop.
+        attach = matched_pages + ([shared_tail[0]] if shared_tail else [])
         parked_matches = sum(
-            1 for pid in matched_pages if self._records[pid].ref_count == 0
+            1 for pid in attach if self._records[pid].ref_count == 0
         )
         if need_fresh > self._available_for_take() - parked_matches:
             return None
@@ -237,25 +327,75 @@ class KvPageManager:
             if data is None:
                 break
             host_pages.append((h, data[0], data[1]))
-        for pid in matched_pages:  # commit the reuse
+        for pid in attach:  # commit the reuse
             self._ref_page(pid)
         fresh = [self._take_free() for _ in range(need_fresh)]
         uploads = [
             (fresh[j], h, k, v) for j, (h, k, v) in enumerate(host_pages)
         ]
-        self.hits += len(matched_pages) + len(host_pages)
+        # Register this sequence's own full prompt pages NOW (pending
+        # fill): a same-prefix request admitted next can share them.
+        # Upload pages are registered by the scheduler with the chain
+        # walk it already does (_register_uploads); pages past the
+        # uploads are this request's to compute.
+        if self.sharing:
+            for j in range(len(host_pages), need_fresh):
+                block_idx = len(matched_pages) + j
+                if (block_idx + 1) * ps > n_tokens:
+                    break  # partial tail block: never registered early
+                h = hashes[block_idx]
+                if h in self._by_hash:
+                    continue  # racing duplicate content: stay private
+                rec = self._records[fresh[j]]
+                rec.seq_hash = h
+                rec.filled = False
+                rec.filler = request_id
+                self._by_hash[h] = fresh[j]
+                block = list(tokens[block_idx * ps : (block_idx + 1) * ps])
+                parent = hashes[block_idx - 1] if block_idx else None
+                self.index.insert(parent, h, tokens=block, payload=fresh[j])
+                if self.event_cb:
+                    self.event_cb(
+                        KvEvent(
+                            "stored", [h], parent_hash=parent,
+                            token_blocks=[block],
+                        )
+                    )
+        self.hits += len(attach) + len(host_pages)
         self.misses += need_fresh - len(host_pages)
         if self.host_pool is not None:
             self.offload_hits += len(host_pages)
             self.offload_misses += need_fresh - len(host_pages)
-        cached = (len(matched_pages) + len(host_pages)) * ps
-        return Allocation(matched_pages + fresh, cached, uploads, hashes)
+        self.prefix_hits["shared"] += len(attach)
+        self.prefix_hits["restore"] += len(host_pages)
+        self.prefix_hits["miss"] += need_fresh - len(host_pages)
+        cached_pages = len(matched_pages) + len(host_pages)
+        cached = cached_pages * ps + (shared_tail[1] if shared_tail else 0)
+        cached = min(cached, n_tokens - 1)
+        wait_fill = [
+            pid for pid in attach if not self._records[pid].filled
+        ]
+        page_ids = matched_pages + fresh
+        if shared_tail:
+            page_ids = matched_pages + fresh + [shared_tail[0]]
+        self._note_active()
+        return Allocation(
+            page_ids,
+            cached,
+            uploads,
+            hashes,
+            cached_pages=cached_pages,
+            wait_fill=wait_fill,
+            shared_tail=shared_tail,
+        )
 
     def allocate_page(self) -> int | None:
         """One fresh page (decode crossing a page boundary)."""
         if self._available_for_take() < 1:
             return None
-        return self._take_free()
+        pid = self._take_free()
+        self._note_active()
+        return pid
 
     # ------------------------------------------------------------- lifecycle
     def register_full_page(
@@ -264,17 +404,33 @@ class KvPageManager:
         seq_hash: int,
         parent_hash: int | None = None,
         tokens: list[int] | None = None,
+        content_ready: bool = True,
     ) -> None:
-        """A page just got its page_size-th token: make it reusable and
-        announce it to the router index."""
+        """A page just got its page_size-th token (or was pre-registered
+        for a pending fill): make it reusable and announce it to the
+        router index. ``content_ready=False`` registers the page as
+        matchable while its data is still on the way (G2 uploads before
+        injection); the engine marks it filled at the injecting
+        dispatch."""
         rec = self._records[page_id]
         if rec.seq_hash == seq_hash:
+            if content_ready:
+                rec.filled = True
+                rec.filler = ""
             return
+        if rec.seq_hash is not None:
+            # Re-registration under different content (tests / page
+            # repurposing): the stale index entry must go first.
+            self._unregister(page_id)
         # A different page may already hold this content (two requests with
         # the same prompt racing); keep the first registration authoritative.
         if seq_hash not in self._by_hash:
             rec.seq_hash = seq_hash
+            rec.filled = content_ready
             self._by_hash[seq_hash] = page_id
+            self.index.insert(
+                parent_hash, seq_hash, tokens=tokens, payload=page_id
+            )
             if self.event_cb:
                 self.event_cb(
                     KvEvent(
@@ -285,18 +441,92 @@ class KvPageManager:
                     )
                 )
 
+    # ------------------------------------------------------- fill lifecycle
+    def mark_filled(self, page_ids: Sequence[int]) -> None:
+        """The write materializing these pages has been dispatched:
+        waiting sharers may dispatch reads (device stream order now
+        protects them)."""
+        for pid in page_ids:
+            rec = self._records[pid]
+            rec.filled = True
+            rec.filler = ""
+
+    def begin_fill(self, page_id: int, request_id: str) -> None:
+        """Mark a registered page as pending content from ``request_id``
+        (G2 upload awaiting its inject dispatch)."""
+        rec = self._records[page_id]
+        rec.filled = False
+        rec.filler = request_id
+
+    def fill_state(self, page_id: int) -> str:
+        """"filled" | "pending" (live filler) | "orphaned" (filler died
+        before dispatching the write; a sharer must claim + re-fill)."""
+        rec = self._records[page_id]
+        if rec.filled:
+            return "filled"
+        return "pending" if rec.filler else "orphaned"
+
+    def claim_fill(self, page_id: int, request_id: str) -> None:
+        """A sharer adopts an orphaned page: it will re-prefill the
+        block itself (deterministic forward ⇒ identical content)."""
+        rec = self._records[page_id]
+        if not rec.filled:
+            rec.filler = request_id
+
+    def abort_fills(self, request_id: str, page_ids: Sequence[int]) -> None:
+        """The filler is going away (finish/cancel/preempt) with writes
+        not yet dispatched: orphan its pending pages so sharers can
+        claim them. Call BEFORE releasing the refs."""
+        for pid in page_ids:
+            rec = self._records[pid]
+            if not rec.filled and rec.filler == request_id:
+                rec.filler = ""
+
+    def make_private(self, page_id: int) -> int | None:
+        """Copy-on-write entry point: the caller is about to write a
+        divergent value into ``page_id``.
+
+        - Sole holder: the page just leaves the index (content offloads
+          to G2 first — it is still a valid block for future prompts)
+          and is returned as-is.
+        - Shared: allocate a replacement page; the caller must device-
+          copy content old→new, swap its table entry, and drop its ref
+          on the old page. Returns None when the pool is dry (caller
+          treats it as a hard stall and retries).
+        """
+        rec = self._records[page_id]
+        if rec.ref_count <= 1:
+            if rec.seq_hash is not None:
+                if self.on_evict is not None and rec.filled:
+                    self.on_evict(page_id, rec.seq_hash)
+                self._unregister(page_id)
+            rec.filled = True
+            rec.filler = ""
+            return page_id
+        new_pid = self.allocate_page()
+        if new_pid is None:
+            return None
+        self.cow_copies += 1
+        return new_pid
+
     def release_sequence(self, page_ids: Sequence[int]) -> None:
-        """Sequence finished: drop refs. Registered pages park in the LRU
-        (still matchable); unregistered pages return to the free list."""
+        """Sequence finished: drop refs. Registered *filled* pages park
+        in the LRU (still matchable); unfilled registered pages — a
+        fill that never happened — unregister (their bytes are garbage)
+        and return to the free list with the rest."""
         for pid in page_ids:
             rec = self._records[pid]
             if rec.ref_count > 0:
                 rec.ref_count -= 1
+                if rec.ref_count == 1:
+                    self.live_shared -= 1
             if rec.ref_count == 0:
-                if rec.seq_hash is not None:
+                if rec.seq_hash is not None and rec.filled:
                     self._reclaimable[pid] = None
                     self._reclaimable.move_to_end(pid)
                 else:
+                    if rec.seq_hash is not None:
+                        self._unregister(pid)
                     self._free.append(pid)
 
     # ---------------------------------------------------------------- leases
@@ -308,7 +538,9 @@ class KvPageManager:
         """Pin ``page_ids`` (one extra ref each) for a KV handoff in
         flight; returns the lease id the wire protocol carries. Must be
         called while the pages are still referenced (before the owning
-        sequence is released), i.e. on the engine loop thread."""
+        sequence is released), i.e. on the engine loop thread — or, for
+        the decode-side suffix-transfer pin, on registered resident
+        pages the match just returned."""
         for pid in page_ids:
             self._ref_page(pid)
         lease = KvLease(
@@ -353,6 +585,11 @@ class KvPageManager:
         if rec.ref_count == 0:
             self._reclaimable.pop(pid, None)
         rec.ref_count += 1
+        if rec.ref_count == 2:
+            self.live_shared += 1
+            if self.live_shared > self.peak_shared_pages:
+                self.peak_shared_pages = self.live_shared
+        self._note_active()
 
     def _take_free(self) -> int:
         if self._free:
@@ -364,6 +601,8 @@ class KvPageManager:
         rec = self._records[pid]
         rec.ref_count = 1
         rec.seq_hash = None
+        rec.filled = True
+        rec.filler = ""
         return pid
 
     def _evict(self, pid: int) -> None:
@@ -374,7 +613,19 @@ class KvPageManager:
                 # engine dispatches the on-device gather synchronously
                 # here (stream order protects it from the next forward).
                 self.on_evict(pid, rec.seq_hash)
-            self._by_hash.pop(rec.seq_hash, None)
-            if self.event_cb:
-                self.event_cb(KvEvent("removed", [rec.seq_hash]))
-            rec.seq_hash = None
+            self._unregister(pid)
+
+    def _unregister(self, pid: int) -> None:
+        """Drop a page's registration from the hash map + radix index
+        and announce the removal. Content is NOT offloaded here — the
+        eviction path does that first when the bytes are worth keeping."""
+        rec = self._records[pid]
+        if rec.seq_hash is None:
+            return
+        self._by_hash.pop(rec.seq_hash, None)
+        self.index.remove(rec.seq_hash)
+        if self.event_cb:
+            self.event_cb(KvEvent("removed", [rec.seq_hash]))
+        rec.seq_hash = None
+        rec.filled = True
+        rec.filler = ""

@@ -43,6 +43,12 @@ class RemoteKv:
 
     first_token: int
     pages: "list[tuple[np.ndarray, np.ndarray]]"
+    # Suffix-only transfer (docs/prefix_sharing.md): ``pages[i]`` is
+    # prompt page ``skip_pages + i`` — the decode side already holds
+    # the first ``skip_pages`` pages (pinned under ``pin_lease`` since
+    # the routing decision; the engine releases the pin at inject).
+    skip_pages: int = 0
+    pin_lease: str | None = None
 
 
 class SeqState(enum.Enum):
@@ -100,6 +106,19 @@ class Sequence:
     # G2→G1 injections the engine must dispatch before this prefill:
     # (page_id, seq_hash, k_page, v_page) per page (see kv_manager).
     pending_uploads: list = field(default_factory=list)
+    # Prefix sharing (docs/prefix_sharing.md): attached pages another
+    # sequence is still filling — this sequence's first prefill dispatch
+    # waits until every one is filled (or claims orphans left by a dead
+    # filler and re-fills them itself).
+    wait_fill: list = field(default_factory=list)
+    # Shared partial-tail page (radix partial_match attach): must be
+    # made private (copy-on-write) before this sequence's first decode
+    # write lands in it. -1 = none / already resolved.
+    shared_tail_pid: int = -1
+    # Prompt pages already marked filled with the page manager (the
+    # engine marks [fill_marked, prefill_sent//ps) after each chunk
+    # dispatch; claims of orphaned pages rewind it).
+    fill_marked: int = 0
     # Chained hashes of all full prompt pages (from Allocation) so
     # register_full_pages never rehashes prompt tokens.
     prompt_hashes: list[int] = field(default_factory=list)
@@ -110,6 +129,9 @@ class Sequence:
     # after prefill, gather the prompt's KV pages and hand them here as
     # (first_token, [(k_page, v_page), ...]).
     extract_cb: "Callable[[int, list], None] | None" = None
+    # Suffix-only extraction: leading prompt pages the decode side
+    # already holds (pinned there) — not gathered, not shipped.
+    extract_skip: int = 0
     # Telemetry: the request's trace context (captured from the
     # submitting task's contextvar — the engine loop thread doesn't
     # share it) plus unix-time stage stamps the engine fills in.
@@ -273,14 +295,25 @@ class Scheduler:
                 seq.state = SeqState.FINISHED
                 seq.emit([], FinishReason.ERROR)
                 continue
-            alloc = self.kv.allocate_sequence(seq.prompt, self.cfg.max_pages_per_seq)
+            alloc = self.kv.allocate_sequence(
+                seq.prompt, self.cfg.max_pages_per_seq, seq.request_id
+            )
             if alloc is None:
                 return None  # pool exhausted; retry after some decode frees
             self.waiting.popleft()
             seq.page_ids, seq.cached_len = alloc.page_ids, alloc.cached_len
             seq.pending_uploads = alloc.uploads
             seq.prompt_hashes = alloc.hashes
-            seq.hashed_pages = seq.cached_len // self.kv.page_size
+            seq.wait_fill = list(alloc.wait_fill)
+            seq.shared_tail_pid = (
+                alloc.shared_tail[0] if alloc.shared_tail else -1
+            )
+            # Registered full pages this sequence resumes its hash chain
+            # after (G1 matches + G2 uploads; never the partial tail —
+            # that page registers under ITS OWN chain once this
+            # sequence's tokens complete it post-COW).
+            seq.hashed_pages = alloc.cached_pages
+            seq.fill_marked = alloc.cached_pages
             seq.parent_hash = (
                 alloc.hashes[seq.hashed_pages - 1] if seq.hashed_pages else None
             )
@@ -295,8 +328,11 @@ class Scheduler:
         return None
 
     def _register_uploads(self, seq: Sequence, hashes: list[int]) -> None:
-        """Pages coming back from the host tier are device-resident again:
-        register them so G1 matching + the router index see them."""
+        """Pages coming back from the host tier are about to be device-
+        resident again: register them so G1 matching + the router index
+        see them. Content lands at the inject dispatch (engine
+        ``_apply_uploads``), so they register as pending fills — a
+        same-prefix admission in between shares them but waits."""
         if not seq.pending_uploads:
             return
         ps = self.kv.page_size
@@ -306,9 +342,50 @@ class Scheduler:
             i = first + j
             block = seq.prompt[i * ps : (i + 1) * ps]
             self.kv.register_full_page(
-                pid, seq_hash, parent_hash=parent, tokens=block
+                pid, seq_hash, parent_hash=parent, tokens=block,
+                content_ready=False,
             )
+            self.kv.begin_fill(pid, seq.request_id)
             parent = seq_hash
+
+    # --------------------------------------------------------- fill gating
+    def fill_ready(self, seq: Sequence) -> bool:
+        """True once every attached shared page this sequence depends on
+        has had its fill dispatched. Orphans (filler died first) are
+        claimed here: this sequence re-fills fully covered blocks itself
+        (identical content by determinism); an orphaned partial tail is
+        detached onto a fresh private page instead — other holders may
+        still need the original."""
+        if not seq.wait_fill:
+            return True
+        ps = self.kv.page_size
+        still: list[int] = []
+        for pid in seq.wait_fill:
+            state = self.kv.fill_state(pid)
+            if state == "filled":
+                continue
+            if state == "pending":
+                still.append(pid)
+                continue
+            # Orphaned: adopt or detach.
+            idx = seq.page_ids.index(pid)
+            if (idx + 1) * ps <= len(seq.prompt) and pid != seq.shared_tail_pid:
+                self.kv.claim_fill(pid, seq.request_id)
+                seq.prefill_sent = min(seq.prefill_sent, idx * ps)
+                seq.cached_len = min(seq.cached_len, idx * ps)
+                seq.fill_marked = min(seq.fill_marked, idx)
+            else:
+                fresh = self.kv.allocate_page()
+                if fresh is None:
+                    still.append(pid)  # pool dry: retry next iteration
+                    continue
+                seq.page_ids[idx] = fresh
+                self.kv.release_sequence([pid])
+                seq.shared_tail_pid = -1
+                seq.prefill_sent = min(seq.prefill_sent, idx * ps)
+                seq.cached_len = min(seq.cached_len, idx * ps)
+        seq.wait_fill = still
+        return not still
 
     # ------------------------------------------------------------- lifecycle
     def ensure_pages_until(self, seq: Sequence, position: int) -> bool:
@@ -386,6 +463,10 @@ class Scheduler:
             self.slots[seq.slot] = None
             self.active_count -= 1
             seq.slot = -1
+        # Fills this sequence owed but never dispatched orphan first so
+        # sharers can claim them; THEN the refs drop (a zero-ref
+        # unfilled page unregisters instead of parking as matchable).
+        self.kv.abort_fills(seq.request_id, seq.page_ids)
         self.kv.release_sequence(seq.page_ids)
         seq.emit([], reason)
 
@@ -424,6 +505,7 @@ class Scheduler:
             self.slots[seq.slot] = None
             self.active_count -= 1
             seq.slot = -1
+        self.kv.abort_fills(seq.request_id, seq.page_ids)
         self.kv.release_sequence(seq.page_ids)
         seq.page_ids = []
         stop = seq.stop.model_copy(deep=True)
@@ -452,6 +534,9 @@ class Scheduler:
         seq.stalled_since = 0.0
         seq.pending_finish = None
         seq.pending_uploads = []
+        seq.wait_fill = []
+        seq.shared_tail_pid = -1
+        seq.fill_marked = 0
         seq.prompt_hashes = []
         seq.hashed_pages = 0
         seq.parent_hash = None

@@ -1,0 +1,17 @@
+"""KV prefix-sharing primitives (docs/prefix_sharing.md).
+
+``PrefixIndex`` is the radix/trie index over registered page-aligned
+token runs shared by three consumers:
+
+- the engine's :class:`~dynamo_exp_tpu.engine.kv_manager.KvPageManager`
+  (page-aligned longest-prefix match at admission, partial-tail lookup
+  for copy-on-write sharing),
+- the KV router's per-instance coverage index
+  (:mod:`dynamo_exp_tpu.kv_router.indexer`), and
+- the cluster simulator's shared-prefix residency model
+  (:mod:`dynamo_exp_tpu.sim`).
+"""
+
+from .prefix import PrefixIndex
+
+__all__ = ["PrefixIndex"]

@@ -2,11 +2,15 @@
 
 Capability parity with ``/root/reference/lib/llm/src/kv_router/indexer.rs``
 (``RadixTree::{find_matches,apply_event,remove_worker}`` :239-391,
-``KvIndexer`` :499-608, ``KvIndexerSharded`` :677-790), redesigned around
-the chained-hash property of our blocks: because each block's sequence
-hash commits to its entire prefix (``tokens.py``), prefix containment is
-a chain walk — a flat ``hash -> workers`` map plus contiguity bookkeeping
-is equivalent to the reference's radix tree with O(1) updates.
+``KvIndexer`` :499-608, ``KvIndexerSharded`` :677-790), built on the
+SAME radix structure the owning engines match against
+(:class:`dynamo_exp_tpu.kv.PrefixIndex`): one tree per worker, fed by
+the stored/removed event stream. An overlap query walks each worker's
+tree exactly like that worker's own page manager would walk its index —
+the score IS the per-instance coverage, not an approximation — and the
+tree's orphan semantics mean a mid-chain eviction detaches (not
+destroys) the suffix, restoring full coverage if the block is
+re-registered.
 
 Single-writer: events are applied on the indexer's asyncio task, queries
 run on the same loop — the same discipline the reference enforces with
@@ -18,9 +22,9 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
-from collections import defaultdict
 from typing import Sequence
 
+from ..kv import PrefixIndex
 from ..tokens import HASH_ALGO_VERSION, compute_block_hashes_for_seq
 from .protocols import KvCacheEventData, OverlapScores, RouterEvent
 
@@ -28,11 +32,10 @@ logger = logging.getLogger(__name__)
 
 
 class RadixIndex:
-    """hash -> set(worker) with per-worker reverse index."""
+    """Per-worker radix prefix trees over chained block hashes."""
 
     def __init__(self):
-        self._workers_by_hash: dict[int, set[int]] = defaultdict(set)
-        self._hashes_by_worker: dict[int, set[int]] = defaultdict(set)
+        self._per_worker: dict[int, PrefixIndex] = {}
 
     def apply_event(self, event: RouterEvent) -> None:
         if event.hash_version != HASH_ALGO_VERSION:
@@ -44,44 +47,43 @@ class RadixIndex:
         w = event.worker_id
         data: KvCacheEventData = event.data
         if data.kind == "stored":
+            index = self._per_worker.setdefault(w, PrefixIndex())
+            # Within one event the hashes chain: parent_hash parents the
+            # first block, each block parents the next (the engine emits
+            # one block per event; batched senders chain).
+            parent = data.parent_hash
             for h in data.block_hashes:
-                self._workers_by_hash[h].add(w)
-                self._hashes_by_worker[w].add(h)
+                index.insert(parent, h)
+                parent = h
         elif data.kind == "removed":
+            index = self._per_worker.get(w)
+            if index is None:
+                return
             for h in data.block_hashes:
-                self._workers_by_hash.get(h, set()).discard(w)
-                self._hashes_by_worker.get(w, set()).discard(h)
-                if not self._workers_by_hash.get(h):
-                    self._workers_by_hash.pop(h, None)
+                index.remove(h)
+            if not index.num_blocks:
+                del self._per_worker[w]
         else:
             logger.warning("unknown kv event kind %r", data.kind)
 
     def remove_worker(self, worker_id: int) -> None:
-        for h in self._hashes_by_worker.pop(worker_id, set()):
-            s = self._workers_by_hash.get(h)
-            if s is not None:
-                s.discard(worker_id)
-                if not s:
-                    self._workers_by_hash.pop(h, None)
+        self._per_worker.pop(worker_id, None)
 
     def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
-        """Longest contiguous matched prefix per worker: worker w scores
-        i+1 only if it held blocks 0..i."""
+        """Longest contiguous matched prefix per worker — each worker's
+        tree is walked root-anchored, the same match its engine-side
+        page manager performs at admission."""
         scores: dict[int, int] = {}
-        for i, h in enumerate(seq_hashes):
-            workers = self._workers_by_hash.get(h)
-            if not workers:
-                break
-            for w in workers:
-                if scores.get(w, 0) == i:
-                    scores[w] = i + 1
-            if not any(v == i + 1 for v in scores.values()):
-                break  # no worker extends past i; deeper blocks can't match
-        return OverlapScores({w: s for w, s in scores.items() if s > 0})
+        for w, index in self._per_worker.items():
+            n = index.coverage_blocks(seq_hashes)
+            if n > 0:
+                scores[w] = n
+        return OverlapScores(scores)
 
     @property
     def num_blocks(self) -> int:
-        return len(self._workers_by_hash)
+        """Distinct (worker, block) registrations still indexed."""
+        return sum(ix.num_blocks for ix in self._per_worker.values())
 
 
 class KvIndexer:

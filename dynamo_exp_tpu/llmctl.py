@@ -270,6 +270,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sim.add_argument("--events", action="store_true",
                      help="print the event log instead of the report")
+    sim.add_argument(
+        "--prefix-groups", type=int, default=0,
+        help="assign arrivals to this many shared-prefix groups "
+        "(docs/prefix_sharing.md; 0 = no shared prefixes)",
+    )
+    sim.add_argument(
+        "--prefix-len", type=int, default=0,
+        help="shared prefix length in tokens (default: half the "
+        "prompt, capped at the prompt)",
+    )
+    sim.add_argument(
+        "--no-prefix-sharing", action="store_true",
+        help="private-copy baseline: prefix groups route by overlap "
+        "but every request pays full pages",
+    )
     return p
 
 
@@ -365,6 +380,25 @@ def run_sim(args) -> int:
             users=args.requests or 100_000,
             duration_s=args.duration_s,
         )
+    if args.prefix_groups > 0:
+        # Shared-prefix fleet mix (docs/prefix_sharing.md): arrivals
+        # draw a group seeded independently of the arrival process, so
+        # adding groups never perturbs arrival times.
+        import random as _random
+        from dataclasses import replace as _replace
+
+        grng = _random.Random(args.seed ^ 0x9EF1)
+        workload = [
+            _replace(
+                r,
+                prefix_group=grng.randrange(args.prefix_groups),
+                prefix_len=min(
+                    args.prefix_len or max(r.prompt_len // 2, 1),
+                    r.prompt_len,
+                ),
+            )
+            for r in workload
+        ]
     if args.trace_out:
         workload = list(workload)
         n = save_trace(args.trace_out, workload)
@@ -398,6 +432,7 @@ def run_sim(args) -> int:
         ),
         service=service,
         record_events=args.events,
+        prefix_sharing=not args.no_prefix_sharing,
     )
     sim = ClusterSim(cfg, workload)
     report = sim.run()

@@ -39,6 +39,8 @@ from ..http.admission import (
     RequestShedError,
     ServiceOverloadedError,
 )
+from ..kv import PrefixIndex
+from ..tokens import chain_hash
 from ..kv_router.protocols import ForwardPassMetrics, OverlapScores
 from ..kv_router.scheduler import (
     DefaultWorkerSelector,
@@ -86,6 +88,11 @@ class SimConfig:
     admission_per_instance: bool = False
     # Routing.
     queue_weight: float = 1.0
+    # Fleet-wide prefix sharing (docs/prefix_sharing.md): prefix_group
+    # requests attach refcounted shared pages behind the same radix-
+    # match logic the live engine runs; False models the private-copy
+    # baseline (every request pays full pages for its prefix).
+    prefix_sharing: bool = True
     # Fleet.
     initial_instances: int = 1
     provision_s: float | None = None  # None -> service model's value
@@ -111,7 +118,8 @@ class _SimSeq:
         "priority", "submitted_at", "instance", "epoch", "pages",
         "prompt_len", "remaining", "delivered", "round_budget",
         "gen_round", "itl", "decode_start", "first_token_at", "stalled",
-        "stall_epoch", "cap_hit", "cached_tokens",
+        "stall_epoch", "cap_hit", "cached_tokens", "shared_hashes",
+        "shared_page_count",
     )
 
     def __init__(self, req: SimRequest, now: float):
@@ -137,12 +145,17 @@ class _SimSeq:
         self.stall_epoch = 0  # bumped on each hard stall; stale grace no-ops
         self.cap_hit = False
         self.cached_tokens = 0
+        # Prefix sharing: block hashes this sequence holds refs on, and
+        # how many of its ``pages`` they back (the rest are private).
+        self.shared_hashes: list[int] = []
+        self.shared_page_count = 0
 
 
 class _SimInstance:
     __slots__ = (
         "id", "cfg", "waiting", "bound", "stall_queue", "pages_free",
-        "metrics", "draining", "prefix_seen", "born_at",
+        "metrics", "draining", "prefix_index", "shared_refs", "parked",
+        "born_at",
     )
 
     def __init__(self, iid: int, cfg: SimConfig, now: float):
@@ -154,8 +167,14 @@ class _SimInstance:
         self.pages_free = cfg.pages_per_instance
         self.draining = False
         self.born_at = now
-        # Shared-prefix model for router overlap: group -> cached blocks.
-        self.prefix_seen: dict[int, int] = {}
+        # Prefix sharing (docs/prefix_sharing.md): the SAME radix index
+        # the live page manager matches against, over synthetic per-
+        # group block chains; refcounts per resident block, plus the
+        # zero-ref parked set (counted free; evicted LRU-first as
+        # allocations consume the pool — the live reclaimable LRU).
+        self.prefix_index = PrefixIndex()
+        self.shared_refs: dict[int, int] = {}
+        self.parked: dict[int, None] = {}  # insertion order = LRU
         # One mutable metrics object per instance: the router reads it
         # in place (no per-arrival allocation at fleet scale).
         self.metrics = ForwardPassMetrics(
@@ -227,6 +246,12 @@ class ClusterSim:
         )
         self._chip_seconds = 0.0
         self._chips_since = 0.0
+        # Prefix sharing: lazily built synthetic block-hash chain per
+        # prefix group (chain_hash keeps it deterministic per group id,
+        # independent of arrival order), plus resident-shared-page
+        # accounting for the report.
+        self._prefix_chains: dict[int, list[int]] = {}
+        self._shared_resident = 0  # live + parked shared blocks, fleet-wide
         self.event_log: list[str] = []
         for _ in range(max(cfg.initial_instances, 1)):
             self._spawn_ready()
@@ -239,6 +264,109 @@ class ClusterSim:
         if self.cfg.record_events:
             msg = fmt % args if args else fmt
             self.event_log.append(f"{self.loop.now:.6f} {msg}")
+
+    # ---------------------------------------------------- prefix sharing
+    def _group_hashes(self, group: int, n: int) -> list[int]:
+        """First ``n`` synthetic chained block hashes of a prefix group
+        (the sim's stand-in for real token-block chains — same chain
+        function, deterministic per group id)."""
+        chain = self._prefix_chains.setdefault(group, [])
+        while len(chain) < n:
+            parent = chain[-1] if chain else None
+            chain.append(chain_hash(parent, (group << 20) | len(chain)))
+        return chain[:n]
+
+    def _take_pages(self, inst: _SimInstance, n: int) -> None:
+        """Consume pool pages, evicting parked (zero-ref, still-indexed)
+        shared blocks LRU-first once free pages no longer cover them —
+        the live manager's reclaimable-LRU eviction."""
+        inst.pages_free -= n
+        while inst.parked and len(inst.parked) > inst.pages_free:
+            h = next(iter(inst.parked))
+            del inst.parked[h]
+            inst.prefix_index.remove(h)
+            self._shared_resident -= 1
+
+    def _release_shared(self, inst: _SimInstance, seq: _SimSeq) -> None:
+        """Drop the sequence's refs on its shared blocks; zero-ref
+        blocks park (page counted free again, block still matchable
+        until evicted)."""
+        for h in seq.shared_hashes:
+            left = inst.shared_refs.get(h, 0) - 1
+            if left > 0:
+                inst.shared_refs[h] = left
+            else:
+                inst.shared_refs.pop(h, None)
+                inst.parked[h] = None
+                inst.pages_free += 1
+        seq.shared_hashes = []
+        seq.shared_page_count = 0
+
+    def _note_prefix_resident(self, inst: _SimInstance, seq: _SimSeq) -> None:
+        """Baseline (prefix_sharing=False) bookkeeping: record the
+        group's blocks for routing overlap and set the warm-prefill
+        credit, with no page accounting (every request pays full
+        pages)."""
+        ps = self.cfg.page_size
+        n_shared = min(seq.req.prefix_len, seq.prompt_len) // ps
+        hashes = self._group_hashes(seq.req.prefix_group, n_shared)
+        matched = inst.prefix_index.match_hashes(hashes)
+        parent = hashes[len(matched) - 1] if matched else None
+        for h in hashes[len(matched) :]:
+            inst.prefix_index.insert(parent, h)
+            parent = h
+        seq.cached_tokens = min(len(matched) * ps, seq.prompt_len - 1)
+
+    def _attach_prefix(self, inst: _SimInstance, seq: _SimSeq) -> bool:
+        """Admission-time radix match + shared-page attach for a
+        prefix_group request (mirrors KvPageManager.allocate_sequence:
+        attach resident blocks refcounted, register the rest as this
+        request's to fill, COW when a resident block extends the
+        prompt's partial tail). Returns False when the pool can't cover
+        the request right now."""
+        cfg = self.cfg
+        ps = cfg.page_size
+        total = _pages(seq.prompt_len, ps)
+        n_shared = min(seq.req.prefix_len, seq.prompt_len) // ps
+        hashes = self._group_hashes(seq.req.prefix_group, n_shared + 1)
+        matched = inst.prefix_index.match_hashes(hashes[:n_shared])
+        new = hashes[len(matched) : n_shared]
+        revive = [h for h in matched if h in inst.parked]
+        cow = (
+            seq.req.prefix_len >= seq.prompt_len
+            and seq.prompt_len % ps != 0
+            and len(matched) == n_shared
+            and len(inst.prefix_index.match_hashes(hashes[: n_shared + 1]))
+            == n_shared + 1
+        )
+        need = len(new) + len(revive) + (total - n_shared)
+        if need > inst.pages_free:
+            return False
+        for h in revive:
+            del inst.parked[h]
+        self._take_pages(inst, need)
+        parent = hashes[len(matched) - 1] if matched else None
+        for h in new:
+            inst.prefix_index.insert(parent, h)
+            parent = h
+            self._shared_resident += 1
+            self.report.shared_pages_peak = max(
+                self.report.shared_pages_peak, self._shared_resident
+            )
+        for h in matched + new:
+            inst.shared_refs[h] = inst.shared_refs.get(h, 0) + 1
+        seq.shared_hashes = matched + new
+        seq.shared_page_count = n_shared
+        seq.pages = total
+        seq.cached_tokens = min(len(matched) * ps, seq.prompt_len - 1)
+        if cow:
+            self.report.cow_copies += 1
+            seq.cached_tokens = seq.prompt_len - 1
+        # Same accounting as KvPageManager.prefix_hits["shared"]: full-
+        # block attaches plus the COW partial-tail attach (calibration
+        # compares these counts exactly).
+        self.report.shared_attached_pages += len(matched) + (1 if cow else 0)
+        return True
 
     # ------------------------------------------------------------ fleet
     def _chips(self) -> int:
@@ -328,10 +456,18 @@ class ClusterSim:
         )
         overlaps = OverlapScores()
         if req.prefix_group >= 0:
+            # Real per-instance index coverage — the router walks the
+            # same radix trees admissions registered into, exactly like
+            # the live KV router over worker prefix indexes.
+            q = self._group_hashes(
+                req.prefix_group,
+                min(req.prefix_len, req.prompt_len) // self.cfg.page_size,
+            )
             overlaps = OverlapScores(
                 scores={
-                    i.id: i.prefix_seen.get(req.prefix_group, 0)
+                    i.id: n
                     for i in candidates
+                    if (n := i.prefix_index.coverage_blocks(q)) > 0
                 }
             )
         try:
@@ -349,19 +485,6 @@ class ClusterSim:
         inst = self.instances[wid]
         seq = _SimSeq(req, self.loop.now)
         seq.instance = inst
-        if req.prefix_group >= 0:
-            # Cache state at routing time decides this request's hit;
-            # only then does its own prefix become resident (the first
-            # request of a group is cold even on its own instance).
-            seq.cached_tokens = min(
-                inst.prefix_seen.get(req.prefix_group, 0)
-                * self.cfg.page_size,
-                req.prefix_len,
-            )
-            inst.prefix_seen[req.prefix_group] = max(
-                inst.prefix_seen.get(req.prefix_group, 0),
-                _pages(req.prefix_len, self.cfg.page_size),
-            )
         self._open += 1
         inst.waiting.append(seq)
         self._log("req %d -> inst %d (overlap %d)", req.index, wid, overlap_blocks)
@@ -388,12 +511,24 @@ class ClusterSim:
                 inst.waiting.popleft()
                 self._finish(seq, "error")
                 continue
-            need = _pages(seq.prompt_len, cfg.page_size) - seq.pages
-            if need > inst.pages_free:
-                return  # pool exhausted; retry after a release
-            inst.waiting.popleft()
-            inst.pages_free -= max(need, 0)
-            seq.pages += max(need, 0)
+            if cfg.prefix_sharing and seq.req.prefix_group >= 0:
+                if not self._attach_prefix(inst, seq):
+                    return  # pool exhausted; retry after a release
+                inst.waiting.popleft()
+            else:
+                need = _pages(seq.prompt_len, cfg.page_size) - seq.pages
+                if need > inst.pages_free:
+                    return  # pool exhausted; retry after a release
+                inst.waiting.popleft()
+                self._take_pages(inst, max(need, 0))
+                seq.pages += max(need, 0)
+                if seq.req.prefix_group >= 0:
+                    # Private-copy baseline: full pages, but overlap
+                    # routing and warm-prefill credit stay (a routing-
+                    # only index, never page-accounted or evicted) so
+                    # the sharing A/B isolates page residency, not a
+                    # routing-policy change.
+                    self._note_prefix_resident(inst, seq)
             seq.state = SeqState.PREFILL
             inst.bound.append(seq)
             prefill_tokens = seq.prompt_len
@@ -443,7 +578,7 @@ class ClusterSim:
             seq.prompt_len + max(seq.round_budget - 1, 0), cfg.page_size
         )
         grab = min(max(need_total - seq.pages, 0), inst.pages_free)
-        inst.pages_free -= grab
+        self._take_pages(inst, grab)
         seq.pages += grab
         return grab
 
@@ -545,7 +680,8 @@ class ClusterSim:
         victim.prompt_len += gen
         victim.remaining -= gen
         victim.preemptions += 1
-        inst.pages_free += victim.pages
+        inst.pages_free += victim.pages - victim.shared_page_count
+        self._release_shared(inst, victim)
         victim.pages = 0
         inst.bound.remove(victim)
         if victim.stalled:
@@ -589,7 +725,8 @@ class ClusterSim:
         seq.epoch += 1
         seq.state = SeqState.FINISHED
         if inst is not None:
-            inst.pages_free += seq.pages
+            inst.pages_free += seq.pages - seq.shared_page_count
+            self._release_shared(inst, seq)
             seq.pages = 0
             if seq in inst.bound:
                 inst.bound.remove(seq)

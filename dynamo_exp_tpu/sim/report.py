@@ -44,6 +44,14 @@ class SimReport:
     goodput_requests: int = 0
     slo_violations_ttft: int = 0
     slo_violations_itl: int = 0
+    # Fleet-wide prefix sharing (docs/prefix_sharing.md): pages a
+    # prefix_group admission attached instead of allocating (radix-match
+    # hits on already-resident blocks), the high-water mark of resident
+    # shared blocks across the fleet, and copy-on-write page copies
+    # (a resident block extended a prompt's partial tail).
+    shared_attached_pages: int = 0
+    shared_pages_peak: int = 0
+    cow_copies: int = 0
     # Tokens delivered per decode dispatch under the fitted speculative
     # decoding factor (1.0 = speculation off): `llmctl sim` runs fitted
     # from spec-tagged telemetry report it so spec-on fleet studies are

@@ -184,7 +184,30 @@ class Telemetry:
         self.kv_page_moves = Counter(
             "dynamo_kv_page_moves_total",
             "KV pages moved by batched gather/scatter, by operation",
-            ["op"],  # extract | inject | upload | offload
+            ["op"],  # extract | inject | upload | offload | cow
+            registry=self.registry,
+        )
+        # Fleet-wide prefix sharing (docs/prefix_sharing.md): pages
+        # resident once but attached by several live sequences, copies
+        # made when a sharer's first divergent write hits a shared page,
+        # and the page-granular admission hit breakdown.
+        self.kv_shared_pages = Gauge(
+            "dynamo_kv_shared_pages",
+            "Device KV pages currently attached by more than one holder",
+            registry=self.registry,
+        )
+        self.kv_cow_copies = Counter(
+            "dynamo_kv_cow_copies_total",
+            "Shared KV pages duplicated copy-on-write before a "
+            "divergent write",
+            registry=self.registry,
+        )
+        self.kv_prefix_hits = Counter(
+            "dynamo_kv_prefix_hits_total",
+            "Prompt pages at admission by source: shared (G1 attach, "
+            "refcounted), restore (G2 host-tier upload), miss (fresh "
+            "prefill)",
+            ["kind"],  # shared | restore | miss
             registry=self.registry,
         )
         # Fault-tolerance counters (docs/fault_tolerance.md): retries and
@@ -441,6 +464,10 @@ class Telemetry:
         for key in self.engine_gauges:
             if key in metrics:
                 self.engine_gauges[key].set(float(metrics[key]))
+        if "kv_shared_pages" in metrics:
+            # Standalone gauge (not dynamo_engine_*-prefixed): the
+            # fleet-wide prefix-sharing headline series.
+            self.kv_shared_pages.set(float(metrics["kv_shared_pages"]))
 
     def render(self) -> bytes:
         from prometheus_client import generate_latest

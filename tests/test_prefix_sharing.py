@@ -1,0 +1,683 @@
+"""Fleet-wide prefix sharing (docs/prefix_sharing.md): radix index
+units, refcounted copy-on-write page manager behavior, shared-vs-private
+token identity, pending-fill (in-flight) sharing, suffix-only disagg
+transfer, and the aggregate-context capacity win."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.engine import EngineConfig, KvPageManager, TPUEngine
+from dynamo_exp_tpu.kv import PrefixIndex
+from dynamo_exp_tpu.models import TINY
+from dynamo_exp_tpu.parallel import single_device_mesh
+from dynamo_exp_tpu.protocols.common import BackendInput
+from dynamo_exp_tpu.tokens import compute_block_hashes_for_seq
+
+PS = 8
+
+
+# ------------------------------------------------------------ radix index
+def _chain(tokens, ps=4):
+    return compute_block_hashes_for_seq(tokens, ps)
+
+
+def test_index_insert_and_match_compressed_run():
+    idx = PrefixIndex()
+    toks = list(range(1, 17))
+    hashes = _chain(toks)  # 4 blocks of 4
+    parent = None
+    for i, h in enumerate(hashes):
+        assert idx.insert(parent, h, tokens=toks[i * 4 : (i + 1) * 4])
+        parent = h
+    assert idx.num_blocks == 4
+    assert idx.match_hashes(hashes) == hashes
+    assert idx.match_hashes(hashes[:2]) == hashes[:2]
+    # A foreign chain matches nothing.
+    assert idx.match_hashes(_chain(list(range(50, 66)))) == []
+    # Re-insert is a refresh, not a duplicate.
+    assert not idx.insert(None, hashes[0])
+    assert idx.num_blocks == 4
+
+
+def test_index_split_on_divergence():
+    idx = PrefixIndex()
+    a = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]
+    b = a[:8] + [90, 91, 92, 93]
+    ha, hb = _chain(a), _chain(b)
+    parent = None
+    for h in ha:
+        idx.insert(parent, h)
+        parent = h
+    # Diverging insert splits the compressed run at block 2.
+    idx.insert(hb[1], hb[2])
+    assert idx.match_hashes(ha) == ha
+    assert idx.match_hashes(hb) == hb
+    assert idx.num_blocks == 4  # 3 shared-chain blocks + 1 divergent
+
+
+def test_index_remove_orphans_and_reattach():
+    idx = PrefixIndex()
+    toks = list(range(1, 17))
+    hashes = _chain(toks)
+    parent = None
+    for h in hashes:
+        idx.insert(parent, h)
+        parent = h
+    # Evicting a middle block detaches the suffix (no root-anchored
+    # match past the hole) without destroying it.
+    idx.remove(hashes[1])
+    assert idx.match_hashes(hashes) == hashes[:1]
+    assert idx.num_blocks == 3
+    assert idx.num_orphans == 2
+    # Re-registering the missing block re-attaches the suffix.
+    idx.insert(hashes[0], hashes[1])
+    assert idx.match_hashes(hashes) == hashes
+    assert idx.num_orphans == 0
+
+
+def test_index_partial_match_needs_tokens():
+    idx = PrefixIndex()
+    toks = list(range(10, 22))  # 3 blocks of 4
+    hashes = _chain(toks)
+    idx.insert(None, hashes[0], tokens=toks[:4])
+    idx.insert(hashes[0], hashes[1], tokens=toks[4:8])
+    # Tail [14, 15] is a prefix of block 1's tokens.
+    assert idx.partial_match(hashes[0], toks[4:6]) == (hashes[1], 2)
+    # Mismatching tail, empty tail, missing parent: no match.
+    assert idx.partial_match(hashes[0], [99]) is None
+    assert idx.partial_match(hashes[0], []) is None
+    assert idx.partial_match(12345, toks[4:6]) is None
+    # Blocks indexed hash-only (router side) never partial-match.
+    idx2 = PrefixIndex()
+    idx2.insert(None, hashes[0])
+    assert idx2.partial_match(None, toks[:2]) is None
+
+
+def test_index_payloads():
+    idx = PrefixIndex()
+    h = _chain([1, 2, 3, 4])[0]
+    idx.insert(None, h, payload=41)
+    assert idx.payload(h) == 41
+    idx.set_payload(h, 42)
+    assert idx.payloads_for([h]) == [42]
+    idx.remove(h)
+    assert idx.payload(h) is None
+
+
+# ------------------------------------------------------------ page manager
+def _register_chain(kv, tokens):
+    hashes = compute_block_hashes_for_seq(tokens, kv.page_size)
+    alloc = kv.allocate_sequence(tokens, max_pages=64, request_id="seed")
+    parent = None
+    for i, h in enumerate(hashes):
+        kv.register_full_page(
+            alloc.page_ids[i], h, parent_hash=parent,
+            tokens=tokens[i * kv.page_size : (i + 1) * kv.page_size],
+        )
+        parent = h
+    return alloc, hashes
+
+
+def test_manager_concurrent_same_prompt_shares_pending_pages():
+    kv = KvPageManager(num_pages=16, page_size=4)
+    prompt = list(range(1, 14))  # 3 full blocks + 1 tail token
+    a = kv.allocate_sequence(prompt, max_pages=8, request_id="a")
+    used_after_a = kv.active_pages
+    # Second identical admission BEFORE any prefill: attaches A's
+    # pending pages, waits on their fill.
+    b = kv.allocate_sequence(prompt, max_pages=8, request_id="b")
+    assert b.page_ids[:3] == a.page_ids[:3]
+    assert b.cached_len == 12
+    assert set(b.wait_fill) == set(a.page_ids[:3])
+    # Only B's private tail page was newly taken.
+    assert kv.active_pages == used_after_a + 1
+    assert kv.shared_pages == 3
+    assert kv.prefix_hits["shared"] == 3
+    # A dispatches its fill: B unblocks.
+    assert kv.fill_state(a.page_ids[0]) == "pending"
+    kv.mark_filled(a.page_ids[:3])
+    assert all(kv.fill_state(p) == "filled" for p in b.page_ids[:3])
+
+
+def test_manager_orphaned_fill_claim_and_garbage_unregister():
+    kv = KvPageManager(num_pages=16, page_size=4)
+    prompt = list(range(1, 10))  # 2 full blocks + tail
+    a = kv.allocate_sequence(prompt, max_pages=8, request_id="a")
+    b = kv.allocate_sequence(prompt, max_pages=8, request_id="b")
+    # A dies before filling: its pending pages orphan; B claims.
+    kv.abort_fills("a", a.page_ids)
+    kv.release_sequence(a.page_ids)
+    assert kv.fill_state(b.page_ids[0]) == "orphaned"
+    kv.claim_fill(b.page_ids[0], "b")
+    assert kv.fill_state(b.page_ids[0]) == "pending"
+    # An unfilled registered page whose LAST ref drops unregisters
+    # (garbage bytes must never be matchable) instead of parking.
+    hashes = compute_block_hashes_for_seq(prompt, 4)
+    kv.abort_fills("b", b.page_ids)
+    kv.release_sequence(b.page_ids)
+    assert kv.match_prefix(prompt) == ([], [])
+    assert hashes[0] not in kv.index
+
+
+def test_manager_full_cover_keeps_all_pages_shared():
+    kv = KvPageManager(num_pages=16, page_size=4)
+    prompt = list(range(1, 9))  # exactly 2 blocks
+    a, _ = _register_chain(kv, prompt)
+    kv.mark_filled(a.page_ids)
+    kv.release_sequence(a.page_ids)
+    b = kv.allocate_sequence(prompt, max_pages=8, request_id="b")
+    # The old trim re-prefilled a whole page; now the entire match
+    # attaches and only the last token recomputes.
+    assert b.page_ids == a.page_ids
+    assert b.cached_len == len(prompt) - 1
+    assert b.shared_tail is None  # aligned: no divergent write coming
+
+
+def test_manager_partial_tail_attach_and_cow():
+    kv = KvPageManager(num_pages=16, page_size=4)
+    owner = list(range(1, 9))  # 2 registered blocks
+    a, hashes = _register_chain(kv, owner)
+    kv.mark_filled(a.page_ids)
+    # B's prompt ends inside A's second block.
+    b = kv.allocate_sequence(owner[:6], max_pages=8, request_id="b")
+    assert b.shared_tail == (a.page_ids[1], 2)
+    assert b.cached_len == 5  # everything but the last token
+    assert b.page_ids == a.page_ids  # no fresh page at all
+    # Divergent write with A still holding refs: COW to a new page.
+    new_pid = kv.make_private(a.page_ids[1])
+    assert new_pid not in (None, a.page_ids[1])
+    assert kv.cow_copies == 1
+    # Sole-holder case: unregister-in-place, no copy.
+    kv.release_sequence([new_pid])
+    kv.release_sequence(a.page_ids)  # A's refs gone; B still holds
+    pid = b.page_ids[0]
+    assert kv.make_private(pid) == pid
+    assert hashes[0] not in kv.index
+    assert kv.cow_copies == 1
+
+
+def test_manager_sharing_off_is_private_copy_baseline():
+    kv = KvPageManager(num_pages=16, page_size=4, sharing=False)
+    prompt = list(range(1, 9))
+    a, _ = _register_chain(kv, prompt)
+    b = kv.allocate_sequence(prompt, max_pages=8, request_id="b")
+    assert set(a.page_ids).isdisjoint(b.page_ids)
+    assert b.cached_len == 0 and b.wait_fill == []
+    assert kv.prefix_hits["shared"] == 0
+
+
+def test_manager_refcounted_eviction_and_lease_pins():
+    kv = KvPageManager(num_pages=4, page_size=4)
+    prompt = list(range(1, 5))
+    a, hashes = _register_chain(kv, prompt)
+    kv.mark_filled(a.page_ids)
+    b = kv.allocate_sequence(prompt + [9], max_pages=8, request_id="b")
+    lease = kv.grant_lease(a.page_ids[:1], ttl_s=60.0)
+    kv.release_sequence(a.page_ids)
+    kv.release_sequence(b.page_ids)
+    # Page 0 still pinned by the lease: exhausting the pool must not
+    # evict it (a page leaves G1 only at refcount zero).
+    assert kv.allocate_page() is not None  # b's tail page reclaimed
+    assert kv.allocate_page() is not None
+    assert kv.allocate_page() is not None
+    assert kv.allocate_page() is None  # only the leased page remains
+    assert hashes[0] in kv.index
+    kv.confirm_lease(lease)
+    assert kv.allocate_page() is not None  # now evictable
+
+
+# --------------------------------------------------------------- engines
+def make_engine(sharing=True, slots=4, pages=96, spec="off", **kw):
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=slots,
+        page_size=PS,
+        num_pages=pages,
+        max_model_len=256,
+        eos_token_ids=[],
+        prefix_sharing=sharing,
+        spec_mode=spec,
+        **kw,
+    )
+    return TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+
+
+async def run_req(engine, prompt, n=6, seed=None, temperature=None,
+                  freq_pen=None):
+    b = BackendInput(token_ids=list(prompt))
+    b.stop_conditions.max_tokens = n
+    b.stop_conditions.ignore_eos = True
+    if seed is not None:
+        b.sampling_options.seed = seed
+    if temperature is not None:
+        b.sampling_options.temperature = temperature
+    if freq_pen is not None:
+        b.sampling_options.frequency_penalty = freq_pen
+    stream = await engine.generate(b.to_dict())
+    tokens = []
+    async for item in stream:
+        tokens.extend(item.get("token_ids", []))
+    return tokens
+
+
+def _prefix_prompts(n, prefix_tokens, suffix_tokens, rs):
+    prefix = rs.randint(3, 200, size=prefix_tokens).tolist()
+    return [
+        prefix + rs.randint(3, 200, size=suffix_tokens).tolist()
+        for _ in range(n)
+    ]
+
+
+async def test_concurrent_shared_burst_identity_and_page_collapse():
+    """The headline: 8 concurrent same-prefix requests are token-
+    identical to the private-copy baseline while resident pages
+    collapse >= 4x (shared prefix attached once, pending-fill sharing
+    included — every request is admitted before the first finishes)."""
+    rs = np.random.RandomState(7)
+    prompts = _prefix_prompts(8, 16 * PS, 4, rs)
+    shared_eng = make_engine(sharing=True, slots=8, pages=8 * 20 + 16)
+    private_eng = make_engine(sharing=False, slots=8, pages=8 * 20 + 16)
+    shared_eng.start()
+    private_eng.start()
+    try:
+        want = await asyncio.gather(
+            *[run_req(private_eng, p, n=4) for p in prompts]
+        )
+        private_peak = private_eng.kv.peak_active_pages
+        got = await asyncio.gather(
+            *[run_req(shared_eng, p, n=4) for p in prompts]
+        )
+        shared_peak = shared_eng.kv.peak_active_pages
+        assert got == want
+        assert shared_eng.kv.prefix_hits["shared"] > 0
+        assert shared_eng.kv.peak_shared_pages >= 16
+        # >= 4x fewer resident pages than the private-copy baseline.
+        assert shared_peak * 4 <= private_peak, (shared_peak, private_peak)
+    finally:
+        shared_eng.stop()
+        private_eng.stop()
+
+
+async def test_seeded_and_penalized_identity_with_sharing():
+    """Sampled decode over shared pages equals private-copy decode:
+    counter-based sampling keys on absolute position, not page
+    identity."""
+    rs = np.random.RandomState(11)
+    prompts = _prefix_prompts(3, 4 * PS, 3, rs)
+    kwargs = [
+        dict(seed=101, temperature=0.9),
+        dict(seed=202, temperature=0.8, freq_pen=0.5),
+        dict(),  # greedy rides in the same batch
+    ]
+    shared_eng = make_engine(sharing=True)
+    private_eng = make_engine(sharing=False)
+    shared_eng.start()
+    private_eng.start()
+    try:
+        want = [
+            await run_req(private_eng, p, n=5, **kw)
+            for p, kw in zip(prompts, kwargs)
+        ]
+        got = await asyncio.gather(
+            *[
+                run_req(shared_eng, p, n=5, **kw)
+                for p, kw in zip(prompts, kwargs)
+            ]
+        )
+        assert list(got) == want
+    finally:
+        shared_eng.stop()
+        private_eng.stop()
+
+
+async def test_spec_on_identity_with_sharing():
+    """Speculative decoding over shared prefix pages stays token-
+    identical to plain private-copy decode (repetitive prompts so the
+    n-gram drafter actually engages)."""
+    rs = np.random.RandomState(13)
+    block = rs.randint(3, 200, size=8).tolist()
+    prefix = (block * (2 * PS // 8 + 1))[: 2 * PS]
+    prompts = [prefix + rs.randint(3, 200, size=2).tolist() for _ in range(3)]
+    spec_eng = make_engine(sharing=True, spec="ngram")
+    private_eng = make_engine(sharing=False)
+    spec_eng.start()
+    private_eng.start()
+    try:
+        want = [await run_req(private_eng, p, n=6) for p in prompts]
+        got = await asyncio.gather(
+            *[run_req(spec_eng, p, n=6) for p in prompts]
+        )
+        assert list(got) == want
+    finally:
+        spec_eng.stop()
+        private_eng.stop()
+
+
+async def test_full_cover_readmission_identity():
+    """A page-aligned prompt whose every block is resident: the old
+    trim re-prefilled a full page; now everything attaches and only the
+    last token recomputes — token-identically."""
+    rs = np.random.RandomState(17)
+    prompt = rs.randint(3, 200, size=3 * PS).tolist()
+    eng = make_engine(sharing=True)
+    eng.start()
+    try:
+        first = await run_req(eng, prompt, n=5)
+        hits0 = eng.kv.prefix_hits["shared"]
+        again = await run_req(eng, prompt, n=5)
+        assert again == first
+        assert eng.kv.prefix_hits["shared"] >= hits0 + 3
+    finally:
+        eng.stop()
+
+
+async def test_partial_tail_cow_engine_identity():
+    """B's prompt ends inside a block A registered: B attaches A's page
+    as a shared tail, COWs it before its first decode write (A is still
+    decoding — a real divergent-write hazard), and emits exactly the
+    private-copy tokens."""
+    rs = np.random.RandomState(19)
+    base = rs.randint(3, 200, size=2 * PS).tolist()
+    short = base[: PS + 4]  # ends inside A's second block
+    eng = make_engine(sharing=True)
+    oracle = make_engine(sharing=False)
+    eng.start()
+    oracle.start()
+    try:
+        want_a = asyncio.ensure_future(run_req(eng, base, n=24))
+        # Let A's prefill register its blocks before B is admitted.
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if eng.kv.match_prefix(base)[0]:
+                break
+        got_b = await run_req(eng, short, n=5)
+        want_b = await run_req(oracle, short, n=5)
+        await want_a
+        assert got_b == want_b
+        assert eng.kv.cow_copies >= 1
+    finally:
+        eng.stop()
+        oracle.stop()
+
+
+async def test_preempt_resume_identity_with_shared_prefix():
+    """KV-pressure preemption with sharing on: same-prefix requests on
+    a pressure-sized pool resume token-identically to an ample-pool
+    run (the continuation re-attaches its own parked pages)."""
+    rs = np.random.RandomState(23)
+    prompts = _prefix_prompts(3, 2 * PS, 2, rs)
+    ample = make_engine(sharing=True, pages=96)
+    tight = make_engine(
+        sharing=True, pages=14, preempt_stall_grace_s=0.05
+    )
+    ample.start()
+    tight.start()
+    try:
+        want = await asyncio.gather(
+            *[run_req(ample, p, n=12, seed=31 + i, temperature=0.7)
+              for i, p in enumerate(prompts)]
+        )
+        got = await asyncio.gather(
+            *[run_req(tight, p, n=12, seed=31 + i, temperature=0.7)
+              for i, p in enumerate(prompts)]
+        )
+        assert list(got) == list(want)
+    finally:
+        ample.stop()
+        tight.stop()
+
+
+async def test_aggregate_context_twenty_x_pool():
+    """The [scale] target: a shared-system-prompt fleet mix whose
+    aggregate context is >= 20x the page pool completes with zero
+    preemptions — impossible with private copies (one request's pages
+    alone are ~7/8 of the pool)."""
+    rs = np.random.RandomState(29)
+    pool_pages = 28
+    prefix = rs.randint(3, 200, size=20 * PS).tolist()  # 20 of 28 pages
+    n_req = 28
+    prompts = [
+        prefix + rs.randint(3, 200, size=2).tolist() for _ in range(n_req)
+    ]
+    eng = make_engine(
+        sharing=True, slots=4, pages=pool_pages, decode_window=4
+    )
+    eng.start()
+    try:
+        outs = await asyncio.gather(
+            *[run_req(eng, p, n=2) for p in prompts]
+        )
+        assert all(len(o) == 2 for o in outs)
+        aggregate_tokens = sum(len(p) + 2 for p in prompts)
+        assert aggregate_tokens >= 20 * pool_pages * PS
+        assert eng.preempted == 0
+        assert eng.kv.peak_active_pages <= pool_pages
+        assert eng.kv.peak_shared_pages >= 20
+        # Every request past the first attached the 20 prefix pages.
+        assert eng.kv.prefix_hits["shared"] >= 20 * (n_req - 1)
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------------- disagg
+async def test_disagg_suffix_only_transfer():
+    """When the decode side already holds the shared prefix, the wire
+    (and the extract gather) carries only the unshared suffix — and the
+    stream is still token-identical to a local run."""
+    from dynamo_exp_tpu.disagg import (
+        DisaggConfig,
+        DisaggConfigWatcher,
+        DisaggDecodeEngine,
+        KvPageReceiver,
+        PrefillWorker,
+    )
+    from dynamo_exp_tpu.runtime.runtime import CancellationToken
+    from dynamo_exp_tpu.runtime.transports.inproc import (
+        InProcDiscovery,
+        InProcWorkQueue,
+    )
+
+    def disagg_engine():
+        cfg = EngineConfig(
+            model=TINY,
+            max_decode_slots=2,
+            page_size=PS,
+            num_pages=64,
+            max_model_len=128,
+            eos_token_ids=[],
+            kv_dtype="float32",  # bit-exact transfer assertions
+        )
+        return TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+
+    prefill_eng = disagg_engine()
+    decode_eng = disagg_engine()
+    local_eng = disagg_engine()
+    queue = InProcWorkQueue()
+    recv = KvPageReceiver()
+    await recv.start()
+    cancel = CancellationToken()
+    worker = PrefillWorker(prefill_eng, queue, cancel)
+    worker_task = asyncio.ensure_future(worker.run())
+    disc = InProcDiscovery()
+    watcher = DisaggConfigWatcher(
+        disc, "m", default=DisaggConfig(max_local_prefill_length=0)
+    )
+    disagg = DisaggDecodeEngine(decode_eng, queue, recv, watcher)
+    try:
+        rs = np.random.RandomState(37)
+        prefix = rs.randint(3, 200, size=3 * PS).tolist()
+        # Warm the DECODE side so the prefix is resident there.
+        await run_req(decode_eng, prefix + [5], n=2)
+        prompt = prefix + rs.randint(3, 200, size=PS + 4).tolist()
+        want = await run_req(local_eng, prompt, n=8)
+        moves0 = prefill_eng.metrics()["kv_page_moves"]
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = 8
+        b.stop_conditions.ignore_eos = True
+        stream = await disagg.generate(b.to_dict())
+        got = []
+        async for item in stream:
+            got.extend(item.get("token_ids", []))
+        assert got == want
+        assert disagg.remote_prefills == 1
+        assert disagg.blocks_skipped == 3  # the resident prefix pages
+        # The extract gather moved only the suffix pages (5 total - 3).
+        assert prefill_eng.metrics()["kv_page_moves"] - moves0 == 2
+        # No leaked pin: the decode pool quiesces back to zero refs.
+        for _ in range(100):
+            if decode_eng.kv.active_leases == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert decode_eng.kv.active_leases == 0
+    finally:
+        cancel.cancel()
+        await asyncio.wait_for(worker_task, 5)
+        await recv.close()
+        for e in (prefill_eng, decode_eng, local_eng):
+            e.stop()
+
+
+# -------------------------------------------------------------------- sim
+def test_sim_prefix_sharing_collapses_pages_and_counts_cow():
+    from dynamo_exp_tpu.sim import ClusterSim, SimConfig
+    from dynamo_exp_tpu.sim.workload import SimRequest
+
+    def workload(prompt_lens):
+        return [
+            SimRequest(
+                index=i, arrival_s=0.01 * i, prompt_len=pl, max_tokens=4,
+                prefix_group=0, prefix_len=160,
+            )
+            for i, pl in enumerate(prompt_lens)
+        ]
+
+    cfg = dict(
+        slots_per_instance=8, pages_per_instance=64, page_size=16,
+        initial_instances=1, max_inflight=64,
+    )
+    # 8 same-group requests: shared attaches collapse pool usage.
+    shared = ClusterSim(
+        SimConfig(seed=1, prefix_sharing=True, **cfg),
+        workload([168] * 8),
+    ).run()
+    private = ClusterSim(
+        SimConfig(seed=1, prefix_sharing=False, **cfg),
+        workload([168] * 8),
+    ).run()
+    assert shared.completed == private.completed == 8
+    assert shared.shared_attached_pages >= 10 * 7  # later 7 reuse
+    assert shared.shared_pages_peak >= 10
+    assert private.shared_attached_pages == 0
+    # COW: a member whose prompt sits fully inside the group prefix
+    # (partial tail) after a longer member registered its blocks.
+    cow = ClusterSim(
+        SimConfig(seed=2, prefix_sharing=True, **cfg),
+        workload([168, 100]),
+    ).run()
+    assert cow.cow_copies == 1
+    assert cow.completed == 2
+
+
+def test_sim_router_prefers_prefix_resident_instance():
+    from dynamo_exp_tpu.sim import ClusterSim, SimConfig
+    from dynamo_exp_tpu.sim.workload import SimRequest
+
+    reqs = [
+        SimRequest(index=i, arrival_s=0.05 * i, prompt_len=96,
+                   max_tokens=4, prefix_group=7, prefix_len=96)
+        for i in range(6)
+    ]
+    sim = ClusterSim(
+        SimConfig(
+            seed=3, slots_per_instance=8, pages_per_instance=128,
+            page_size=16, initial_instances=3, max_inflight=64,
+            prefix_sharing=True,
+        ),
+        reqs,
+    )
+    report = sim.run()
+    assert report.completed == 6
+    # Real index coverage steers the whole group onto one instance.
+    resident = [
+        i for i in sim.instances.values() if i.prefix_index.num_blocks
+    ]
+    assert len(resident) == 1
+
+
+def test_sim_live_calibration_prefix_counters():
+    """Sim vs live on the same scripted shape: one long member
+    registers the shared prefix, one short member partial-tail-attaches
+    (COW) — shared-attach and COW counts must agree exactly."""
+    eng = make_engine(sharing=True, slots=2, pages=64)
+    eng.start()
+    try:
+        rs = np.random.RandomState(41)
+        base = rs.randint(3, 200, size=2 * PS).tolist()
+
+        async def drive():
+            long = asyncio.ensure_future(run_req(eng, base, n=24))
+            for _ in range(200):
+                await asyncio.sleep(0.02)
+                if eng.kv.match_prefix(base)[0]:
+                    break
+            await run_req(eng, base[: PS + 4], n=4)
+            await long
+
+        asyncio.run(drive())
+        live_shared = eng.kv.prefix_hits["shared"]
+        live_cow = eng.kv.cow_copies
+    finally:
+        eng.stop()
+
+    from dynamo_exp_tpu.sim import ClusterSim, SimConfig
+    from dynamo_exp_tpu.sim.workload import SimRequest
+
+    report = ClusterSim(
+        SimConfig(
+            seed=5, slots_per_instance=2, pages_per_instance=64,
+            page_size=PS, initial_instances=1, max_inflight=16,
+            prefix_sharing=True,
+        ),
+        [
+            SimRequest(index=0, arrival_s=0.0, prompt_len=2 * PS,
+                       max_tokens=24, prefix_group=0, prefix_len=2 * PS),
+            SimRequest(index=1, arrival_s=1.0, prompt_len=PS + 4,
+                       max_tokens=4, prefix_group=0, prefix_len=2 * PS),
+        ],
+    ).run()
+    # Live: the short member attached 1 full block + the shared tail
+    # (2 shared hits) and COWed once. Sim: identical counts.
+    assert report.cow_copies == live_cow == 1
+    assert report.shared_attached_pages == live_shared == 2
+
+
+# ------------------------------------------------------------------ router
+def test_router_index_recovers_coverage_after_reinsert():
+    from dynamo_exp_tpu.kv_router.indexer import RadixIndex
+    from dynamo_exp_tpu.kv_router.protocols import (
+        KvCacheEventData,
+        RouterEvent,
+    )
+
+    idx = RadixIndex()
+    toks = list(range(1, 33))
+    hashes = compute_block_hashes_for_seq(toks, 8)
+    parent = None
+    for h in hashes:
+        idx.apply_event(
+            RouterEvent(1, KvCacheEventData("stored", [h], parent))
+        )
+        parent = h
+    assert idx.find_matches(hashes).scores == {1: 4}
+    # Mid-chain eviction detaches (score drops to the hole) ...
+    idx.apply_event(RouterEvent(1, KvCacheEventData("removed", [hashes[1]])))
+    assert idx.find_matches(hashes).scores == {1: 1}
+    # ... and re-registration restores FULL coverage (orphan re-attach;
+    # the flat map this replaced could do no better than re-learn
+    # blocks one event at a time — here the suffix was never lost).
+    idx.apply_event(
+        RouterEvent(1, KvCacheEventData("stored", [hashes[1]], hashes[0]))
+    )
+    assert idx.find_matches(hashes).scores == {1: 4}
