@@ -104,7 +104,8 @@ def _dispatch_stats(engine) -> dict:
     """Per-kind dispatch-timing percentiles (p50/p99 host-gap and
     in-flight) from the engine's dispatch profiler, attached to every
     bench JSON line — so ``sim/fit.py --fit-bench`` can fit service
-    times without a span file (it reads ``dispatch.decode`` together
+    times without a span file (it reads ``dispatch.ragged`` — or the
+    retired ``dispatch.decode`` of pre-ragged bench files — together
     with the line's ``decode_window``). Kinds that never dispatched in
     the run keep count 0 / null percentiles."""
     disp = engine.metrics().get("dispatch") or {}
@@ -305,12 +306,118 @@ def run_occupancy_sweep(
                 ),
                 "active": active,
                 "slots": slots,
-                "compiled_decode_variants": m["compiled_decode_variants"],
-                "compiled_prefill_variants": m["compiled_prefill_variants"],
+                "compiled_ragged_variants": m["compiled_ragged_variants"],
                 "wasted_steps": engine.wasted_steps - wasted0,
                 "kv_page_moves": engine.kv_page_moves - moves0,
                 "decode_window": engine.cfg.decode_window,
                 "dispatch": _dispatch_stats(engine),
+            }
+        )
+
+    # ---------------- mixed prefill+decode axis (ragged late-join) ----------
+    # Late prompts injected MID-decode: `active` established decoders
+    # chain windows; once they have visibly stepped, `n_late` short
+    # prompts arrive. On the ragged engine a latecomer's chunk rides
+    # the next compute dispatch together with the decode rows (one
+    # mixed program), so its TTFT should sit under one decode-window
+    # duration — `late_join_ttft_p50_s` vs the window's in-flight p50
+    # is the acceptance comparison, and the variant count shows the
+    # mixed shapes landing in the SAME compiled cache.
+    isl_late = max(isl // 4, 16)
+    n_late = max(slots - max(slots // 2, 1), 1)
+    # Established rows must still be decoding when the lates land: give
+    # them several windows of runway past the injection point.
+    long_osl = max(osl, 6 * cfg.decode_window)
+
+    async def long_one(prompt):
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = long_osl
+        b.stop_conditions.ignore_eos = True
+        stream = await engine.generate(b.to_dict())
+        n = 0
+        async for item in stream:
+            n += len(item.get("token_ids", []))
+        return n
+
+    async def late_one(prompt):
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = 8
+        b.stop_conditions.ignore_eos = True
+        t0 = time.perf_counter()
+        stream = await engine.generate(b.to_dict())
+        ttft = None
+        n = 0
+        async for item in stream:
+            if item.get("token_ids") and ttft is None:
+                ttft = time.perf_counter() - t0
+            n += len(item.get("token_ids", []))
+        return n, ttft
+
+    async def mixed_point(active: int) -> tuple[float, list, float]:
+        def late_prompts():
+            return [
+                rs.randint(10, mcfg.vocab_size - 10, size=isl_late).tolist()
+                for _ in range(n_late)
+            ]
+
+        # Warmup: compile the mixed (prefill+decode in one dispatch)
+        # variants this axis exercises, then time one injection burst.
+        for _ in range(WARMUP_BURSTS):
+            jobs = [
+                asyncio.ensure_future(long_one(p)) for p in prompts(active)
+            ]
+            await asyncio.sleep(0)
+            lates = [asyncio.ensure_future(late_one(p)) for p in late_prompts()]
+            await asyncio.gather(*jobs, *lates)
+        jobs = [asyncio.ensure_future(long_one(p)) for p in prompts(active)]
+        # Wait until the established rows have demonstrably stepped
+        # (at least one full decode window) before injecting.
+        steps0 = engine.steps
+        t0 = time.perf_counter()
+        while (
+            engine.steps < steps0 + engine.cfg.decode_window
+            and time.perf_counter() - t0 < 60.0
+        ):
+            await asyncio.sleep(0.005)
+        lates = [asyncio.ensure_future(late_one(p)) for p in late_prompts()]
+        results = await asyncio.gather(*jobs, *lates)
+        dt = time.perf_counter() - t0
+        total = sum(r[0] if isinstance(r, tuple) else r for r in results)
+        ttfts = sorted(t for _, t in results[active:] if t is not None)
+        return total / dt, ttfts, dt
+
+    
+
+    for active in sorted({1, max(slots // 2, 1)}):
+        tok_s, ttfts, _dt = asyncio.run(mixed_point(active))
+        m = engine.metrics()
+        disp = _dispatch_stats(engine)
+        # Windows are the slowest ragged dispatches in this phase, so
+        # the kind's in-flight p99 approximates one full decode-window
+        # duration — the bound the late-join TTFT is judged against
+        # (mixed single-step batches drag the p50 far below it).
+        window_s = (disp.get("ragged") or {}).get("in_flight_p99_s")
+        p50_ttft = ttfts[len(ttfts) // 2] if ttfts else None
+        out.append(
+            {
+                "metric": f"decode_mixed_{MODEL}_isl{isl}_osl{osl}"
+                f"_a{active}of{slots}_late{n_late}",
+                "value": round(tok_s, 1),
+                "unit": "tok/s",
+                "vs_baseline": round(
+                    tok_s / _roofline_tok_s(engine.params, active + n_late), 4
+                ),
+                "active": active,
+                "slots": slots,
+                "late": n_late,
+                "late_isl": isl_late,
+                "late_join_ttft_p50_s": round(p50_ttft, 4)
+                if p50_ttft is not None
+                else None,
+                "window_in_flight_p99_s": window_s,
+                "compiled_ragged_variants": m["compiled_ragged_variants"],
+                "decode_window": engine.cfg.decode_window,
+                "dispatch": disp,
             }
         )
     engine.stop()
@@ -902,7 +1009,9 @@ def main() -> None:
         "--occupancy-sweep",
         action="store_true",
         help="tok/s at 1/2/4/8 active sequences of 8 slots (compacted "
-        "decode proportionality curve)",
+        "decode proportionality curve) plus a mixed prefill+decode "
+        "axis: late prompts injected mid-decode, reporting late-join "
+        "TTFT and compiled-ragged-variant counts per line",
     )
     ap.add_argument(
         "--overload-sweep",

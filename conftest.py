@@ -55,7 +55,7 @@ _NIGHTLY_FILES = {
     "test_run_cli.py",  # multi-process discovery serve
     "test_sdk.py",  # SDK supervisor lifecycle
     "test_multihost.py",  # jax.distributed bring-up subprocesses
-    "test_paged_decode.py",  # Pallas interpret-mode vs XLA oracle
+    "test_ragged_attention.py",  # ragged kernel interpret-mode vs ref oracle
     "test_logprobs.py",  # engine logprob oracle runs
     "test_disagg.py",  # two-engine disagg e2e
     "test_decode_compaction.py",  # occupancy-proportional decode proofs

@@ -21,7 +21,7 @@ rules instead of CHANGES.md folklore (docs/static_analysis.md):
   are flagged, as are accesses to lock-guarded state outside its
   ``with lock:`` block.
 - ``recompile-hazard`` — dispatch sites that key compiled-variant
-  caches (``_decode_fns``/``_prefill_fns``/``_spec_fns``, the
+  caches (``_ragged_fns``, the
   gather/scatter page movers) must derive shape-carrying key
   components through the ``*_bucket_for`` helpers; a raw dynamic int
   in a variant key is a recompile storm waiting for an unlucky load.

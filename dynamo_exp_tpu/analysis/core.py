@@ -3,7 +3,7 @@
 The waiver grammar is the one reviewable escape hatch every rule
 shares (docs/static_analysis.md "Waivers"):
 
-    # dynlint: sync-point(decode window consume)
+    # dynlint: sync-point(ragged consume)
     # dynlint: determinism(host-only wall-clock report field)
 
 One comment may carry several waivers (space-separated). A waiver

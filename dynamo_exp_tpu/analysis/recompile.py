@@ -1,12 +1,12 @@
 """recompile-hazard checker: variant-cache keys must be bucketed.
 
-The engine's compiled-program families (`_decode_fns`, `_prefill_fns`,
-`_spec_fns`, the jit-internal gather/scatter shape cache) key variants
+The engine's compiled-program caches (`_ragged_fns`, the jit-internal
+gather/scatter shape cache) key variants
 by static shapes. The whole lattice stays O(log) *only* because every
 shape-carrying key component passes through a bucket helper
-(``decode_rows_bucket_for``, ``page_bucket_for``,
+(``ragged_tokens_bucket_for``, ``ragged_page_bucket_for``,
 ``page_move_bucket_for``, …). One raw dynamic int in a key position —
-``self._decode_fn(len(part), …)`` — compiles a fresh program per
+``self._ragged_fn(len(part), …)`` — compiles a fresh program per
 distinct value under real load: a recompile storm the steady-state
 guard test only catches for the shapes it happens to drive.
 

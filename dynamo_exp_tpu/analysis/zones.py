@@ -1,9 +1,11 @@
 """Declared dynlint zones and manifests (docs/static_analysis.md).
 
 This file is the one place the lint suite learns *where* each contract
-applies. The upcoming ragged-kernel refactor rewrites dispatch sites —
-when files split or move, update the declarations here (and the doc)
-and the checkers follow.
+applies. The ragged-dispatch rewrite (docs/engine_perf.md "One ragged
+dispatch") moved the engine's dispatch sites onto ``_ragged_fn`` /
+``_build_windowed`` / ``_build_mixed`` — when files split or move
+again, update the declarations here (and the doc) and the checkers
+follow.
 """
 
 from __future__ import annotations
@@ -80,9 +82,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_counts",
                 "_inflight",
                 "_pending_offloads",
-                "_decode_fns",
-                "_prefill_fns",
-                "_spec_fns",
+                "_ragged_fns",
                 "_spec",
                 "steps",
                 "wasted_steps",
@@ -176,9 +176,10 @@ VARIANT_SITE_MANIFESTS: tuple[VariantSiteManifest, ...] = (
     VariantSiteManifest(
         path="dynamo_exp_tpu/engine/engine.py",
         sites={
-            "_decode_fn": (0, 1),
-            "_prefill_fn": (0, 1, 2),
-            "_spec_fn": (0, 1, 2),
+            # (total padded query tokens, page bound) — the two
+            # shape-carrying axes of the collapsed ragged lattice; the
+            # trailing windowed/sampler/lp key components are bools.
+            "_ragged_fn": (0, 1),
             "_gather_pages": (2,),
             "_inject_pages": (2,),
         },

@@ -186,12 +186,6 @@ class EngineConfig:
                 return b
         return None
 
-    def page_bucket_for(self, n_pages: int) -> int:
-        """Static page-count bucket for the XLA attention gather: next
-        power of two >= n_pages (min 4), capped at max_pages_per_seq.
-        Bounds the compile-variant count to O(log Pmax)."""
-        return self._pow2_bucket(n_pages, 4, self.max_pages_per_seq)
-
     @staticmethod
     def _pow2_bucket(n: int, floor: int, cap: int | None = None) -> int:
         """Next power of two >= n, starting at ``floor``, optionally
@@ -207,19 +201,56 @@ class EngineConfig:
         """Prefill-batch row bucket (1/2/4/.../prefill_batch)."""
         return self._pow2_bucket(n, 1, self.prefill_batch)
 
-    def decode_rows_bucket_for(self, n: int) -> int:
-        """Decode-batch row bucket (1/2/4/.../max_decode_slots): the
-        compiled decode window computes only this many rows, so decode
-        FLOPs and HBM traffic track true occupancy, not the slot
-        envelope."""
+    def ragged_tokens_bucket_for(self, n: int, mixed: bool = False) -> int:
+        """Total-padded-query-token bucket of one ragged dispatch
+        (docs/engine_perf.md "One ragged dispatch"): the flat mixed
+        query stream — every row's true query tokens, summed — pads to
+        the next power of two. This single axis replaces the old
+        (decode rows x prefill rows x prefill tokens x spec draft)
+        shape dimensions: a lone decode row buckets to 1, a full decode
+        batch to ``max_decode_slots``, a prefill chunk to its length —
+        compute tracks the true total, and the variant lattice is
+        O(log total) instead of a product of per-family axes.
+
+        ``mixed`` batches floor at 16 tokens: a short prefill tail or a
+        draft span costs one 16-wide forward either way, and the floor
+        keeps transient small shapes from fragmenting the lattice.
+        Windowed (pure-decode) batches floor at 1 — so decode cost
+        keeps tracking occupancy exactly — and cap at
+        ``max_decode_slots`` (a non-power-of-two slot envelope must not
+        round its full-occupancy window up past the slots that exist)."""
+        if mixed:
+            return self._pow2_bucket(n, 16, self.ragged_max_tokens)
         return self._pow2_bucket(n, 1, self.max_decode_slots)
 
-    def spec_draft_bucket_for(self, n: int) -> int:
-        """Static draft-slot bucket for the speculative verify dispatch
-        (2/4/8/... capped at spec_max_draft): one compiled verify
-        variant per bucket, same O(log) discipline as every other
-        static-shape family."""
-        return self._pow2_bucket(n, 2, max(self.spec_max_draft, 2))
+    def ragged_page_bucket_for(self, n_pages: int) -> int:
+        """Static page bound of a ragged dispatch's XLA attention
+        gather. Floors at ~1024 tokens of pages: below that the
+        gather's HBM traffic is trivial (the same threshold the
+        attention-impl resolution uses), so bucketing finer than it
+        only multiplies compiled variants. Capped at the per-sequence
+        table width; the Pallas kernel ignores the bound entirely (it
+        DMAs true lengths), which is what deletes the page axis from
+        the TPU lattice."""
+        floor = min(self.max_pages_per_seq, max(4, 1024 // self.page_size))
+        return self._pow2_bucket(
+            max(n_pages, floor), 4, self.max_pages_per_seq
+        )
+
+    @property
+    def ragged_max_tokens(self) -> int:
+        """Upper bound of one ragged dispatch's flat query stream: every
+        slot prefilling a full chunk plus every slot speculating at the
+        widest draft (whichever mix arrives, the bucket can hold it)."""
+        per_row = max(self.prefill_chunk, self.spec_max_draft + 1)
+        n = self.max_decode_slots * (per_row + self.ragged_q_tile - 1)
+        return self._pow2_bucket(n, 1)
+
+    # Flat-stream alignment of each row's query span when the Pallas
+    # ragged kernel serves the dispatch: every kernel grid cell must
+    # belong to exactly one row (ops/ragged_attention.py). The XLA
+    # reference path packs tight (alignment 1).
+    ragged_q_tile: int = 8
 
     def page_move_bucket_for(self, n: int) -> int:
         """Static page-count bucket for batched KV page gather/scatter

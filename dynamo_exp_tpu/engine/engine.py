@@ -60,6 +60,7 @@ from ..models.config import ModelConfig
 from ..models.llama import (
     Params,
     forward,
+    forward_ragged,
     init_kv_cache,
     init_params,
     kv_cache_shardings,
@@ -94,64 +95,62 @@ log = logging.getLogger(__name__)
 
 
 @dataclass
-class _PendingDecode:
-    """One dispatched decode window the host has not yet consumed.
+class _RaggedRow:
+    """One row of a ragged dispatch (docs/engine_perf.md "One ragged
+    dispatch"): a chunked-prefill span, a decode step/window, or a
+    speculative verify span — all in the same flat query stream."""
 
-    Holds the device-side results (``ys``) plus the final scan carry
-    (``tokens_dev``/``positions_dev``) — the exact inputs of the next
-    window over the same rows, so a chained dispatch can launch window
-    N+1 straight from device state while the host still owns window N's
-    sync (see ``TPUEngine._dispatch_chained``)."""
+    seq: Sequence
+    kind: str  # "decode" | "prefill" | "spec"
+    row: int  # per-row array index in the dispatch
+    n_valid: int = 0  # decode: window steps the host may keep
+    completing: bool = False  # prefill: prompt finishes this chunk
+    n_drafts: int = 0  # spec: drafts fed for verification
 
-    ys: tuple  # [K, rows] sampled tokens (+ logprob arrays when want_lp)
-    tokens_dev: object  # final carry: next window's input tokens [rows]
-    positions_dev: object  # final carry: next window's positions [rows]
-    stepped: list  # [(Sequence, n_valid, row)]
-    rows: int  # row bucket (array batch dim)
+
+@dataclass
+class _PendingRagged:
+    """One dispatched ragged batch the host has not yet consumed.
+
+    ``windowed=True`` is the pure-decode shape: every row fed one
+    token and the program scanned ``decode_window`` steps on-device,
+    returning the final carry (``tokens_dev``/``positions_dev``) — the
+    exact inputs of the next window over the same rows, so a chained
+    dispatch can launch window N+1 straight from device state while
+    the host still owns window N's sync (``_dispatch_chained``).
+    ``windowed=False`` is the mixed shape (prefill chunks, single
+    decode steps, spec verify spans in one flat stream), consumed in
+    the same iteration — drafts are re-planned and prompts re-chunked
+    from the freshly consumed tokens, so there is nothing to chain."""
+
+    ys: tuple  # windowed: toks [K, nb] (+lp); mixed: tok0 [B1] (+spec, +lp)
+    rows: list  # [_RaggedRow]
+    nb: int  # flat token bucket (array batch dim)
+    windowed: bool
     full_sampler: bool
     want_lp: bool
-    solo: bool  # only decode dispatch of its iteration -> chainable
-    # True when some row could hit its page/model-length cap inside this
-    # window (cap < wpos + K at dispatch). Its device carry position
-    # flips to -1 at the cap, but the host RESUMES such a row after
-    # allocating pages rather than finishing it — so a chained window
-    # would feed the dead carry and emit garbage. Chaining requires this
-    # to be False; stop/budget deaths are safe (the host finishes those
-    # rows at consume and skips them in the successor).
-    capacity_capped: bool
-    stop_tokens: object  # np [rows, S], reused verbatim by a chain
+    solo: bool  # only dispatch of its iteration -> chainable
+    # Mixed batches only: the dispatch carried draft spans, so ys
+    # includes the verify outputs (and the compiled variant is the
+    # spec-carrying one).
+    with_spec: bool = False
+    tokens_dev: object = None  # windowed carry: next window's tokens [nb]
+    positions_dev: object = None  # windowed carry: next positions [nb]
+    # True when some row could hit its page/model-length cap inside
+    # this window (cap < wpos + K at dispatch). Its device carry
+    # position flips to -1 at the cap, but the host RESUMES such a row
+    # after allocating pages rather than finishing it — so a chained
+    # window would feed the dead carry and emit garbage. Chaining
+    # requires this to be False; stop/budget deaths are safe (the host
+    # finishes those rows at consume and skips them in the successor).
+    capacity_capped: bool = False
+    stop_tokens: object = None  # np [nb, S], reused verbatim by a chain
     # (seeds, temp, top_k, top_p, f, p, r) np arrays, reused by a chain.
     sampler_args: tuple | None = None
-    slot_map: object | None = None  # np [rows] (sampler variants only)
+    slot_map: object | None = None  # np (sampler variants only)
     # Dispatch-profiler stamp (monotonic, taken right after the dispatch
     # call returned): the consume's existing host sync closes the pair.
     dispatched_at: float = 0.0
-
-
-@dataclass
-class _PendingPrefill:
-    """One dispatched prefill chunk awaiting its host sync."""
-
-    ys: tuple
-    completed: list  # [(row, Sequence)] rows whose prompt finished
-    want_lp: bool
-    dispatched_at: float = 0.0  # dispatch-profiler stamp
-
-
-@dataclass
-class _PendingSpec:
-    """One dispatched speculative verify pass (docs/speculative.md).
-
-    Always consumed in the same loop iteration it was dispatched —
-    speculation re-plans drafts from the freshly accepted tokens every
-    round, so there is nothing to chain (spec rows break the device-to-
-    device decode chain exactly like capacity-capped rows do)."""
-
-    ys: tuple  # targets [rows, T], n_emit [rows] (+ lp arrays when want_lp)
-    stepped: list  # [(Sequence, n_drafts, row)]
-    full_sampler: bool
-    want_lp: bool
-    dispatched_at: float = 0.0  # dispatch-profiler stamp
 
 
 class TPUEngine(AsyncEngine):
@@ -279,15 +278,14 @@ class TPUEngine(AsyncEngine):
         # for failover replay pins the seed request-side instead.
         self._seed_rng = random.Random(seed + 1)
         self._attn_impl, self._attn_interpret = self._resolve_attn()
-        # Compiled-variant caches. Decode windows are keyed by
-        # (row bucket, attention impl, static page bound — None on the
-        # Pallas path, which reads true lengths — full-vs-greedy sampler,
-        # and want_lp); prefill by (row bucket, token bucket, page bound).
-        self._decode_fns: dict[tuple, Callable] = {}
-        self._prefill_fns: dict[tuple[int, int, int], Callable] = {}
-        # Speculative verify variants, keyed by (row bucket, draft
-        # bucket, page bound, full-vs-greedy sampler, want_lp).
-        self._spec_fns: dict[tuple, Callable] = {}
+        # The ONE compiled-variant cache (docs/engine_perf.md "One
+        # ragged dispatch"): every device program — pure-decode windows,
+        # mixed prefill+decode+spec batches — is keyed by
+        # (total padded query tokens, static page bound — None on the
+        # Pallas path, which reads true lengths — windowed?,
+        # full-vs-greedy sampler, want_lp). This replaces the old
+        # _decode_fns x _prefill_fns x _spec_fns lattice.
+        self._ragged_fns: dict[tuple, Callable] = {}
         # Host-side speculation state (drafter + per-row adaptive
         # controller); None = speculation off.
         self._spec = None
@@ -319,7 +317,7 @@ class TPUEngine(AsyncEngine):
         # local; the caller's sync consumes it in the same call chain).
         self._last_move_t = 0.0
         # Chained decode: the dispatched-but-unconsumed window (if any).
-        self._inflight: _PendingDecode | None = None
+        self._inflight: _PendingRagged | None = None
         # Occupancy/movement counters (mirrored to /metrics counters and
         # surfaced by metrics() for bench.py's occupancy sweep).
         self.wasted_steps = 0  # window steps computed past a row's stop
@@ -358,10 +356,10 @@ class TPUEngine(AsyncEngine):
         the ragged Pallas kernel only when the mesh actually sits on TPU
         (or ``pallas_interpret`` forces interpreter mode for CPU tests);
         anywhere else the length-bounded XLA gather is the correct
-        choice. Layouts Mosaic can't tile (``pallas_supported``) fall
+        choice. Layouts Mosaic can't tile (``ragged_supported``) fall
         back to XLA rather than fail at compile time on the first
         decode."""
-        from ..ops.paged_decode import pallas_supported
+        from ..ops.ragged_attention import ragged_supported
 
         cfg = self.cfg
         impl = cfg.attention_impl
@@ -383,7 +381,7 @@ class TPUEngine(AsyncEngine):
             impl = "xla"
         if impl == "pallas" and not interpret:
             tp = self.mesh.shape.get("tp", 1)
-            if not pallas_supported(
+            if not ragged_supported(
                 cfg.page_size,
                 cfg.model.num_kv_heads // tp,
                 cfg.model.head_dim_,
@@ -401,44 +399,52 @@ class TPUEngine(AsyncEngine):
                 impl = "xla"
         return impl, interpret
 
-    def _decode_fn(
+    def _ragged_fn(
         self,
-        rows: int,
+        nb: int,
         attn_pages: int | None,
+        windowed: bool,
         full_sampler: bool,
         want_lp: bool,
+        with_spec: bool = False,
     ):
-        """One compiled decode *window*: ``decode_window`` steps run
-        on-device under ``lax.scan`` with sampled tokens fed straight
-        back — the host syncs once per window instead of once per token,
-        which is what makes decode throughput survive a high-latency
-        host↔device link.
+        """One compiled ragged program (docs/engine_perf.md "One ragged
+        dispatch"). The variant key is the collapsed lattice
 
-        ``rows`` is the compacted batch dim (decode_rows_bucket_for of
-        the ACTIVE row count), NOT max_decode_slots: at occupancy 1 the
-        window computes 1 row, so decode FLOPs and HBM traffic track
-        true load. ``full_sampler=False`` is the greedy fast path (no
-        penalties, no top-k/p machinery, no RNG, no counts traffic)
-        used for the greedy partition of the batch — one creative
-        request no longer drags every greedy row through the sampler.
+            (total padded query tokens, page bound, windowed,
+             full-vs-greedy sampler, want_lp, with_spec)
 
-        Stop detection runs on-device: each row carries a padded stop
-        set plus EOS/budget step gates, and a row that stops flips its
-        position to -1 mid-window — no garbage KV writes, no page
-        overrun past EOS — which makes large ``decode_window`` values
-        profitable instead of a tail-latency tax. The host's check_stop
-        stays authoritative for everything it can see.
+        — a single token axis where the old engine keyed three compiled
+        families (decode windows by rows x impl x pages x sampler x lp,
+        prefill by rows x token bucket x pages, spec verify by rows x
+        draft bucket x pages x sampler x lp).
 
-        The final scan carry (tokens, positions) is returned so the next
-        window over the same rows can be dispatched device-to-device
-        (chained) before the host syncs on this one.
+        ``windowed=True`` (pure decode: ``nb`` rows, one fed token
+        each) runs ``decode_window`` steps on-device under ``lax.scan``
+        with sampled tokens fed straight back — the host syncs once per
+        window, which is what makes decode throughput survive a
+        high-latency host-device link. Per-row stop sets / step gates
+        park a finished row at position -1 mid-window (no garbage KV
+        writes), and the final carry is returned so the next window can
+        chain device-to-device. This path is byte-for-byte the old
+        compacted decode window: compute tracks true occupancy.
+
+        ``windowed=False`` (mixed) is one ragged forward over a flat
+        query stream: chunked-prefill spans, single decode steps, and
+        speculative verify spans share the dispatch
+        (``models/llama.forward_ragged`` → ``ops/ragged_attention``).
+        Each row samples at its last fed position with the same
+        (seed, absolute position) counter keying a decode window would
+        use — so a prompt's first token, a decode row's next token, and
+        a verify span's accepted prefix are all bit-identical to the
+        two-program schedule. Only the ``max_decode_slots + 1`` sampled
+        positions (plus the spec span when speculation is on) reach the
+        vocab projection, so lm_head cost stays flat in chunk width.
 
         Even when the Pallas kernel is available, short contexts take
         the XLA gather: below ~1k tokens of page bucket the gather's
         HBM traffic is trivial and the kernel's serial per-row DMA grid
-        costs more than it saves. The kernel wins where it matters —
-        long contexts, where gather traffic scales with rows*bucket
-        while the kernel's scales with the true total context."""
+        costs more than it saves."""
         impl, interpret, mesh = self._attn_impl, self._attn_interpret, self.mesh
         if (
             impl == "pallas"
@@ -447,10 +453,23 @@ class TPUEngine(AsyncEngine):
         ):
             impl = "xla"
         pages = None if impl == "pallas" else attn_pages
-        key = (rows, impl, pages, full_sampler, want_lp)
-        fn = self._decode_fns.get(key)
+        key = (nb, pages, windowed, full_sampler, want_lp, with_spec)
+        fn = self._ragged_fns.get(key)
         if fn is not None:
             return fn
+        fn = (
+            self._windowed_program(nb, pages, impl, full_sampler, want_lp)
+            if windowed
+            else self._mixed_program(
+                nb, pages, impl, full_sampler, want_lp, with_spec
+            )
+        )
+        self._ragged_fns[key] = fn
+        return fn
+
+    def _windowed_program(self, nb, pages, impl, full_sampler, want_lp):
+        """Build the pure-decode windowed variant (see _ragged_fn)."""
+        interpret, mesh = self._attn_interpret, self.mesh
         mcfg = self.cfg.model
         K = self.cfg.decode_window
 
@@ -460,7 +479,7 @@ class TPUEngine(AsyncEngine):
                 page_table, k, v, attn_pages=pages, attn_impl=impl,
                 mesh=mesh, interpret=interpret,
             )
-            return logits[:, 0], k, v  # [rows, V]
+            return logits[:, 0], k, v  # [nb, V]
 
         def advance(positions, max_pos, next_tok, stop_set, eos_gate,
                     budget_gate, t, active):
@@ -478,7 +497,7 @@ class TPUEngine(AsyncEngine):
         if full_sampler:
 
             @partial(jax.jit, donate_argnums=(1, 2, 8))
-            def decode_window(params, k, v, tokens, positions, max_pos,
+            def ragged_window(params, k, v, tokens, positions, max_pos,
                               page_table, seeds, counts_all, slot_map, temp,
                               top_k, top_p, freq_pen, pres_pen, rep_pen,
                               stop_set, eos_gate, budget_gate):
@@ -529,14 +548,14 @@ class TPUEngine(AsyncEngine):
                     jnp.arange(K),
                 )
                 counts_all = counts_all.at[slot_map].set(counts)
-                # ys: toks [K,rows] (+ lp [K,rows], top_ids/top_lp
-                # [K,rows,N] when want_lp).
+                # ys: toks [K,nb] (+ lp [K,nb], top_ids/top_lp
+                # [K,nb,N] when want_lp).
                 return ys, k, v, counts_all, tokens, positions
 
         else:
 
             @partial(jax.jit, donate_argnums=(1, 2))
-            def decode_window(params, k, v, tokens, positions, max_pos,
+            def ragged_window(params, k, v, tokens, positions, max_pos,
                               page_table, stop_set, eos_gate, budget_gate):
                 def step(carry, t):
                     tokens, positions, k, v = carry
@@ -564,131 +583,135 @@ class TPUEngine(AsyncEngine):
                 )
                 return ys, k, v, tokens, positions
 
-        self._decode_fns[key] = decode_window
-        return decode_window
+        return ragged_window
 
-    def _prefill_fn(
-        self, rows: int, bucket: int, attn_pages: int, want_lp: bool
-    ):
-        key = (rows, bucket, attn_pages, want_lp)
-        fn = self._prefill_fns.get(key)
-        if fn is not None:
-            return fn
+    def _mixed_program(self, nb, pages, impl, full_sampler, want_lp,
+                       with_spec):
+        """Build the mixed ragged variant (see _ragged_fn): one flat
+        forward over prefill + decode + spec spans, per-row sampling at
+        the last fed position, and — for batches that carry draft spans
+        (``with_spec``) — the verify rule (accepted prefix +
+        correction, with penalty counts threaded past rejections)
+        computed on-device from the same logits. Draft-free batches
+        compile the spec-free program: they pay neither the extra
+        vocab projections nor the verify scan. No decode scan either
+        way: drafts and chunks are re-planned from the freshly
+        consumed tokens every iteration."""
+        interpret, mesh = self._attn_interpret, self.mesh
         mcfg = self.cfg.model
+        B1 = self.cfg.max_decode_slots + 1
+        spec_on = with_spec
+        T_s = self.cfg.spec_max_draft + 1
+        q_tile = self._ragged_align()
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill_step(params, k, v, tokens, positions, page_table, seeds,
-                         last_idx, temp, top_k, top_p):
-            logits, k, v = forward(
-                params, mcfg, tokens, positions, page_table, k, v,
-                attn_pages=attn_pages, last_positions=last_idx,
+        def run_forward(params, k, v, tokens, positions, row_of,
+                        page_table, out_idx):
+            return forward_ragged(
+                params, mcfg, tokens, positions, row_of, page_table,
+                k, v, out_idx, attn_pages=pages, attn_impl=impl,
+                q_tile=q_tile, mesh=mesh, interpret=interpret,
             )
-            # Key the first-token draw by the absolute position of the
-            # prompt's last token — identical to the draw a decode window
-            # would make feeding that token, so prefill chunking and
-            # continuation re-prefills replay the same sample.
-            last_pos = jnp.take_along_axis(
-                positions, last_idx[:, None], axis=1
-            )[:, 0]
-            toks = sample_tokens_seeded(
-                logits[:, 0], seeds, last_pos, temp, top_k, top_p
-            )
-            if want_lp:
-                lp, top_ids, top_lp = token_logprobs(logits[:, 0], toks)
-                return (toks, lp, top_ids, top_lp), k, v
-            return (toks,), k, v
 
-        self._prefill_fns[key] = prefill_step
-        return prefill_step
+        def out_indices(q_last, spec_idx):
+            if not spec_on:
+                return q_last
+            return jnp.concatenate([q_last, spec_idx.reshape(-1)])
 
-    def _spec_fn(
-        self,
-        rows: int,
-        k_bucket: int,
-        attn_pages: int,
-        full_sampler: bool,
-        want_lp: bool,
-    ):
-        """One compiled speculative *verify* pass (docs/speculative.md):
-        the row's last confirmed token plus up to ``k_bucket`` draft
-        tokens ride through the target model as a T = k_bucket + 1 wide
-        chunked-prefill-shaped dispatch (always the XLA paged path —
-        ``forward`` only takes the Pallas decode kernel at T == 1), and
-        the target's counter-keyed token at every absolute position
-        comes back in the same dispatch.
-
-        Because each draw is keyed by (seed, fed position) — the same
-        key the step-by-step decode window would use — the accepted
-        prefix plus the first correction token is *exactly* the token
-        sequence the non-speculative engine would have emitted. The
-        greedy variant is a plain per-position argmax; the full-sampler
-        variant threads penalty counts through a scan with rejected
-        positions masked out of the counts (ops/sampling.
-        spec_verify_tokens), so the penalty state rewinds with the KV.
-
-        KV for positions past the accepted prefix is teacher-forced
-        garbage, but attention masks strictly by query position and the
-        host rewinds ``wpos`` to the accepted length, so the next
-        dispatch overwrites the first garbage slot and never attends
-        past its own position — no garbage KV survives."""
-        key = (rows, k_bucket, attn_pages, full_sampler, want_lp)
-        fn = self._spec_fns.get(key)
-        if fn is not None:
-            return fn
-        mcfg = self.cfg.model
-        pages = attn_pages
-
-        def pack_ys(logits, targets, n_emit):
-            if not want_lp:
-                return (targets, n_emit)
-            V = logits.shape[-1]
+        def pack_spec_lp(spec_logits, targets):
+            V = spec_logits.shape[-1]
             lp, tid, tlp = token_logprobs(
-                logits.reshape(-1, V), targets.reshape(-1)
+                spec_logits.reshape(-1, V), targets.reshape(-1)
             )
-            B, T = targets.shape
             return (
-                targets,
-                n_emit,
-                lp.reshape(B, T),
-                tid.reshape(B, T, -1),
-                tlp.reshape(B, T, -1),
+                lp.reshape(B1, T_s),
+                tid.reshape(B1, T_s, -1),
+                tlp.reshape(B1, T_s, -1),
             )
 
         if full_sampler:
 
-            @partial(jax.jit, donate_argnums=(1, 2, 7))
-            def spec_verify(params, k, v, tokens, positions, page_table,
-                            n_drafts, counts_all, slot_map, seeds, temp,
-                            top_k, top_p, freq_pen, pres_pen, rep_pen):
-                logits, k, v = forward(
-                    params, mcfg, tokens, positions, page_table, k, v,
-                    attn_pages=pages,
+            @partial(jax.jit, donate_argnums=(1, 2, 9))
+            def ragged_mixed(params, k, v, tokens, positions, row_of,
+                             page_table, q_last, pos0, counts_all, slot_map,
+                             is_decode, seeds, temp, top_k, top_p, freq_pen,
+                             pres_pen, rep_pen, spec_idx, spec_pos,
+                             spec_drafts, n_drafts):
+                logits_all, k, v = run_forward(
+                    params, k, v, tokens, positions, row_of, page_table,
+                    out_indices(q_last, spec_idx),
                 )
+                logits0 = logits_all[:B1]
                 counts0 = counts_all[slot_map]
-                targets, n_emit, counts = spec_verify_tokens(
-                    logits, tokens[:, 1:], n_drafts, seeds, positions,
-                    temp, top_k, top_p, counts0, freq_pen, pres_pen,
-                    rep_pen,
+                # Decode rows sample through their penalty counts (the
+                # window rule); a prompt's first token samples the raw
+                # model distribution (the prefill rule — the host
+                # initializes its counts row at consume).
+                shaped = apply_penalties(
+                    logits0, counts0, freq_pen, pres_pen, rep_pen
                 )
+                dec = is_decode[:, None]
+                tok0 = sample_tokens_seeded(
+                    jnp.where(dec, shaped, logits0),
+                    seeds, pos0, temp, top_k, top_p,
+                )
+                counts = counts0.at[jnp.arange(B1), tok0].add(
+                    is_decode.astype(jnp.int32)
+                )
+                if want_lp:
+                    lp0, tid0, tlp0 = token_logprobs(logits0, tok0)
+                ys = (tok0,)
+                if spec_on:
+                    spec_logits = logits_all[B1:].reshape(B1, T_s, -1)
+                    targets, n_emit, counts = spec_verify_tokens(
+                        spec_logits, spec_drafts, n_drafts, seeds,
+                        spec_pos, temp, top_k, top_p, counts, freq_pen,
+                        pres_pen, rep_pen,
+                    )
+                    ys = ys + (targets, n_emit)
                 counts_all = counts_all.at[slot_map].set(counts)
-                return pack_ys(logits, targets, n_emit), k, v, counts_all
+                if want_lp:
+                    ys = ys + (lp0, tid0, tlp0)
+                    if spec_on:
+                        ys = ys + pack_spec_lp(spec_logits, targets)
+                return ys, k, v, counts_all
 
         else:
 
             @partial(jax.jit, donate_argnums=(1, 2))
-            def spec_verify(params, k, v, tokens, positions, page_table,
-                            n_drafts):
-                logits, k, v = forward(
-                    params, mcfg, tokens, positions, page_table, k, v,
-                    attn_pages=pages,
+            def ragged_mixed(params, k, v, tokens, positions, row_of,
+                             page_table, q_last, spec_idx, spec_drafts,
+                             n_drafts):
+                logits_all, k, v = run_forward(
+                    params, k, v, tokens, positions, row_of, page_table,
+                    out_indices(q_last, spec_idx),
                 )
-                targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                n_emit = spec_accept_length(targets, tokens[:, 1:], n_drafts)
-                return pack_ys(logits, targets, n_emit), k, v
+                logits0 = logits_all[:B1]
+                tok0 = jnp.argmax(logits0, axis=-1).astype(jnp.int32)
+                if want_lp:
+                    lp0, tid0, tlp0 = token_logprobs(logits0, tok0)
+                ys = (tok0,)
+                if spec_on:
+                    spec_logits = logits_all[B1:].reshape(B1, T_s, -1)
+                    targets = jnp.argmax(spec_logits, axis=-1).astype(
+                        jnp.int32
+                    )
+                    n_emit = spec_accept_length(
+                        targets, spec_drafts, n_drafts
+                    )
+                    ys = ys + (targets, n_emit)
+                if want_lp:
+                    ys = ys + (lp0, tid0, tlp0)
+                    if spec_on:
+                        ys = ys + pack_spec_lp(spec_logits, targets)
+                return ys, k, v
 
-        self._spec_fns[key] = spec_verify
-        return spec_verify
+        return ragged_mixed
 
+    def _ragged_align(self) -> int:
+        """Flat-stream alignment of each row's query span: the Pallas
+        ragged kernel requires every ``ragged_q_tile`` slice to belong
+        to one row; the XLA reference packs tight."""
+        return self.cfg.ragged_q_tile if self._attn_impl == "pallas" else 1
     # -------------------------------------------------------------- lifecycle
     def start(self) -> None:
         if self._running:
@@ -986,11 +1009,13 @@ class TPUEngine(AsyncEngine):
 
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
-        """One iteration = admit everything admissible, dispatch at most
-        one batched prefill chunk, then one decode window — so decode
-        interleaves between the chunks of long prompts instead of
-        stalling behind them (scheduler v2 policy, ``scheduler.py``
-        module docstring).
+        """One iteration = admit everything admissible, then dispatch
+        ONE ragged batch (per sampler partition) carrying every slot's
+        next unit of work — prefill chunks, decode steps/windows, and
+        spec verify spans in one flat query stream — so a late-arriving
+        prompt joins the in-flight batch the iteration it is admitted
+        and decode still interleaves between the chunks of long prompts
+        (docs/engine_perf.md "One ragged dispatch").
 
         The host pipelines against the device instead of blocking on
         ``np.asarray`` right after each dispatch: a decode window is
@@ -1020,7 +1045,7 @@ class TPUEngine(AsyncEngine):
                         else None
                     )
                     prev, self._inflight = self._inflight, nxt
-                    self._consume_decode(prev)
+                    self._consume_ragged(prev)
                     self._maybe_publish_gauges()
                     self._progress_mark += 1  # consumed a window
                     if self._inflight is not None:
@@ -1082,24 +1107,16 @@ class TPUEngine(AsyncEngine):
                     if seq.remote_kv is not None:
                         self._run_remote_inject(seq)
                         progressed = True
-                pending_prefill = None
-                if batch:
-                    pending_prefill = self._dispatch_prefill_chunk(
-                        batch[: self.cfg.prefill_batch]
-                    )
-                    progressed = True
-                # Decode dispatches BEFORE the prefill sync: the window
-                # executes behind the prefill on the device stream while
-                # the host consumes prefill completions.
-                pendings, spec_pendings = self._dispatch_decode()
-                progressed = progressed or bool(pendings) or bool(spec_pendings)
-                if pending_prefill is not None:
-                    self._consume_prefill(pending_prefill)
-                # Verify passes consume in the same iteration: the next
-                # round's drafts are proposed from the tokens they just
-                # confirmed, so there is nothing to overlap.
-                for sp in spec_pendings:
-                    self._consume_spec(sp)
+                # ONE ragged dispatch per iteration (per sampler
+                # partition): prefill chunks, decode steps/windows, and
+                # spec verify spans share the flat query stream, so a
+                # freshly admitted prompt joins the in-flight batch
+                # immediately instead of waiting behind a separate
+                # prefill program (docs/engine_perf.md).
+                pendings = self._dispatch_ragged(
+                    batch[: self.cfg.prefill_batch]
+                )
+                progressed = progressed or bool(pendings)
                 if (
                     len(pendings) == 1
                     and pendings[0].solo
@@ -1107,8 +1124,11 @@ class TPUEngine(AsyncEngine):
                 ):
                     self._inflight = pendings[0]  # consumed next iteration
                 else:
+                    # Mixed batches consume in the same iteration: the
+                    # next round's chunks and drafts are planned from
+                    # the tokens they just confirmed.
                     for p in pendings:
-                        self._consume_decode(p)
+                        self._consume_ragged(p)
                 if progressed:
                     self._progress_mark += 1
                 else:
@@ -1162,7 +1182,7 @@ class TPUEngine(AsyncEngine):
         if self.profiler is None:
             return {}
         return self.profiler.span_attrs(
-            "decode", decode_window=self.cfg.decode_window
+            "ragged", decode_window=self.cfg.decode_window
         )
 
     def _flight_snapshot(self) -> dict:
@@ -1511,7 +1531,7 @@ class TPUEngine(AsyncEngine):
             resumed_tokens=seq.stop.resume_offset or None,
             # Dispatch-profiler medians (sim/fit.py reads these).
             **(
-                self.profiler.span_attrs("prefill")
+                self.profiler.span_attrs("ragged")
                 if self.profiler is not None
                 else {}
             ),
@@ -1626,133 +1646,119 @@ class TPUEngine(AsyncEngine):
         seq.remote_prefilled = True
         self._finish_first_token(seq, rk.first_token)
 
-    def _dispatch_prefill_chunk(
-        self, batch: list[Sequence]
-    ) -> _PendingPrefill | None:
-        """One batched prefill dispatch: up to ``prefill_batch`` PREFILL
-        sequences each contribute their next ``prefill_chunk``-token
-        slice of prompt. Rows/tokens are bucketed so steady state hits a
-        small set of compiled variants; rows whose prompt completes this
-        chunk get their first token sampled (per-row sampling params) and
-        graduate to decode when the pending result is consumed."""
+    # --------------------------------------------------------- ragged dispatch
+    def _dispatch_ragged(
+        self, prefill_rows: list[Sequence]
+    ) -> list[_PendingRagged]:
+        """Assemble and dispatch this iteration's ragged batch(es)
+        (docs/engine_perf.md "One ragged dispatch"): every slot's next
+        unit of work — a chunked-prefill span, a decode step/window, or
+        a speculative verify span — rides one flat query stream per
+        sampler partition. A late-arriving prompt's chunk therefore
+        joins the in-flight batch the iteration it is admitted; its
+        first token samples in the same dispatch that steps the decode
+        rows, instead of waiting behind a separate prefill program.
+
+        Rows are partitioned greedy-vs-full-sampler (a creative request
+        must not drag greedy rows through the penalty/top-k machinery),
+        so an iteration issues at most two dispatches. A partition that
+        is pure decode (every row one fed token, no drafts) takes the
+        ``windowed`` shape — ``decode_window`` on-device steps, host
+        syncs once per window, chainable device-to-device. Returns the
+        pending dispatches; [] when nothing could step (pool dry / no
+        ACTIVE or ready-PREFILL rows)."""
         cfg = self.cfg
-        ps = cfg.page_size
-        rows = cfg.rows_bucket_for(len(batch))
-        sizes = [
-            min(len(s.prompt) - s.prefill_sent, cfg.prefill_chunk)
-            for s in batch
-        ]
-        bucket = cfg.bucket_for(max(sizes))
-        tokens = np.zeros((rows, bucket), np.int32)
-        positions = np.full((rows, bucket), -1, np.int32)
-        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
-        last_idx = np.zeros(rows, np.int32)
-        seeds = np.zeros(rows, np.int32)
-        temp = np.zeros(rows, np.float32)
-        top_k = np.zeros(rows, np.int32)
-        top_p = np.ones(rows, np.float32)
-        completed: list[tuple[int, Sequence]] = []
-        for i, seq in enumerate(batch):
-            self._apply_uploads(seq)
-            n = sizes[i]
-            start = seq.prefill_sent
-            tokens[i, :n] = seq.prompt[start : start + n]
-            positions[i, :n] = np.arange(start, start + n)
-            table[i, : len(seq.page_ids)] = seq.page_ids
-            last_idx[i] = n - 1
-            seq.prefill_sent = start + n
-            if seq.prefill_sent == len(seq.prompt):
-                completed.append((i, seq))
-            so = seq.stop.sampling_options
-            seeds[i] = seq.sample_seed & 0x7FFFFFFF
-            temp[i] = so.temperature if so.temperature is not None else 0.0
-            top_k[i] = so.top_k or 0
-            top_p[i] = so.top_p if so.top_p is not None else 1.0
-
-        attn_pages = cfg.page_bucket_for(
-            max((s.prefill_sent + ps - 1) // ps for s in batch)
-        )
-        want_lp = any(
-            self._wants_logprobs(seq) is not None for seq in batch
-        )
-        n_variants = len(self._prefill_fns)
-        fn = self._prefill_fn(rows, bucket, attn_pages, want_lp)
-        fresh = len(self._prefill_fns) > n_variants
-        self._flush_offloads()
-        prof = self.profiler
-        t0 = prof.begin("prefill") if prof is not None else 0.0
-        ys, self.k_cache, self.v_cache = fn(
-            self.params,
-            self.k_cache,
-            self.v_cache,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(table),
-            jnp.asarray(seeds),
-            jnp.asarray(last_idx),
-            jnp.asarray(temp),
-            jnp.asarray(top_k),
-            jnp.asarray(top_p),
-        )
-        dispatched_at = (
-            prof.end("prefill", t0, fresh) if prof is not None else 0.0
-        )
-        if self.flight is not None:
-            self.flight.record(
-                "dispatch",
-                dispatch="prefill",
-                rows=len(batch),
-                tokens=int(sum(sizes)),
-                completing=len(completed),
+        ps, K = cfg.page_size, cfg.decode_window
+        greedy: list[tuple[Sequence, int, int]] = []  # (seq, wpos, cap)
+        sampler: list[tuple[Sequence, int, int]] = []
+        for seq in self.sched.slots:
+            if seq is None or seq.state is not SeqState.ACTIVE:
+                continue
+            if seq.shared_tail_pid >= 0 and not self._resolve_shared_tail(seq):
+                # The shared tail page must be private before this row's
+                # first decode write lands in it, and the COW copy found
+                # the pool dry: hard-stall the row (same grace clock as
+                # a dry page allocation).
+                seq.stalled = True
+                if not seq.stalled_since:
+                    seq.stalled_since = time.time()
+                    if self.flight is not None:
+                        self.flight.record(
+                            "stall_start", req=seq.request_id, slot=seq.slot
+                        )
+                continue
+            wpos = len(seq.tokens) - 1  # position of the token being fed
+            # Provision the whole window up front (best effort: partial
+            # allocation still lets the row run until its pages end).
+            self.sched.ensure_pages_until(seq, wpos + K - 1)
+            cap = min(cfg.max_model_len, len(seq.page_ids) * ps) - 1
+            if cap < wpos:
+                if wpos // ps >= self.kv.num_pages:
+                    # The row's own context now exceeds the ENTIRE pool:
+                    # no preemption or wait can ever feed its next token
+                    # on this engine. The pool is this deployment's hard
+                    # context capacity — close the stream with what it
+                    # has (mirrors the max_model_len LENGTH) instead of
+                    # stalling the slot forever.
+                    log.warning(
+                        "request %s reached the KV pool's context "
+                        "capacity (%d pages) at %d tokens; finishing "
+                        "with length",
+                        seq.request_id, self.kv.num_pages, wpos,
+                    )
+                    self.sched.finish(seq, FinishReason.LENGTH)
+                    continue
+                # Hard stall: the row cannot even feed its next token.
+                # Start (or keep) the preemption grace clock.
+                seq.stalled = True
+                if not seq.stalled_since:
+                    seq.stalled_since = time.time()
+                    if self.flight is not None:
+                        self.flight.record(
+                            "stall_start", req=seq.request_id, slot=seq.slot
+                        )
+                continue  # pool dry: this slot idles one window
+            seq.stalled = len(seq.page_ids) * ps < min(
+                wpos + K, cfg.max_model_len
             )
-        # Pages this chunk fully covered are now filled *in dispatch
-        # order*: sharers gated on them may dispatch reads from the next
-        # iteration on (prefix sharing, docs/prefix_sharing.md).
-        newly_filled: list[int] = []
-        for seq in batch:
-            n_full = seq.prefill_sent // ps
-            if n_full > seq.fill_marked:
-                newly_filled.extend(seq.page_ids[seq.fill_marked : n_full])
-                seq.fill_marked = n_full
-        if newly_filled:
-            self.kv.mark_filled(newly_filled)
-        return _PendingPrefill(
-            ys=ys,
-            completed=completed,
-            want_lp=want_lp,
-            dispatched_at=dispatched_at,
-        )
-
-    def _consume_prefill(self, pending: _PendingPrefill) -> None:
-        """Host sync of a prefill chunk: sample-complete rows emit their
-        first token and join decode. Runs after the decode window for
-        this iteration has been dispatched, so the sync overlaps device
-        compute instead of serializing ahead of it."""
-        if not pending.completed:
-            return
-        if pending.want_lp:
-            toks, lps, top_ids, top_lps = (np.asarray(y) for y in pending.ys)  # dynlint: sync-point(prefill consume)
-        else:
-            toks = np.asarray(pending.ys[0])  # dynlint: sync-point(prefill consume)
-        if self.profiler is not None:
-            self.profiler.consume("prefill", pending.dispatched_at)
-        if self.flight is not None:
-            self.flight.record(
-                "consume", dispatch="prefill", completed=len(pending.completed)
-            )
-        for i, seq in pending.completed:
-            n_top = self._wants_logprobs(seq)
-            pack = (
-                self._lp_pack(
-                    n_top, lps[i : i + 1],
-                    top_ids[i : i + 1], top_lps[i : i + 1],
+            if seq.stalled_since and self.flight is not None:
+                self.flight.record(
+                    "stall_end", req=seq.request_id, slot=seq.slot
                 )
-                if pending.want_lp and n_top is not None
-                else None
-            )
-            self._finish_first_token(seq, int(toks[i]), pack)
+            seq.stalled_since = 0.0  # progressing (even if window-capped)
+            part = sampler if self._needs_sampler(seq) else greedy
+            part.append((seq, wpos, cap))
+        spec_parts: dict[bool, list] = {False: [], True: []}
+        if self._spec is not None:
+            greedy, spec_parts[False] = self._extract_spec_rows(greedy)
+            sampler, spec_parts[True] = self._extract_spec_rows(sampler)
+            if len(self._spec) > 4 * cfg.max_decode_slots:
+                self._spec.retain(
+                    s.request_id for s in self.sched.slots if s is not None
+                )
+        pf_parts: dict[bool, list[Sequence]] = {False: [], True: []}
+        for seq in prefill_rows:
+            pf_parts[self._needs_sampler(seq)].append(seq)
+        batches = []
+        for fs, dec in ((False, greedy), (True, sampler)):
+            spec, pf = spec_parts[fs], pf_parts[fs]
+            if not (dec or spec or pf):
+                continue
+            windowed = bool(dec) and not spec and not pf
+            batches.append((fs, dec, spec, pf, windowed))
+        # A window is chainable only when it is the iteration's single
+        # dispatch — a concurrent mixed batch (like a second partition)
+        # means the row set will be re-planned next round.
+        solo = len(batches) == 1 and batches[0][4]
+        out: list[_PendingRagged] = []
+        for fs, dec, spec, pf, windowed in batches:
+            if windowed:
+                out.append(self._build_windowed(dec, fs, solo))
+            else:
+                out.append(self._build_mixed(dec, spec, pf, fs))
+        return out
 
-    # ----------------------------------------------------------------- decode
+    # ------------------------------------------------------------ row helpers
     @staticmethod
     def _needs_sampler(seq: Sequence) -> bool:
         """True when the row needs the full penalty/top-k/top-p sampler
@@ -1825,101 +1831,18 @@ class TPUEngine(AsyncEngine):
         seq.shared_tail_pid = -1
         return True
 
-    def _dispatch_decode(
-        self,
-    ) -> tuple[list[_PendingDecode], list[_PendingSpec]]:
-        """Dispatch this iteration's decode window(s) over the ACTIVE
-        slots: rows are compacted (no dead slots) and partitioned into a
-        greedy window and a full-sampler window, each compiled at its
-        own row bucket — so decode cost tracks occupancy and a lone
-        creative request doesn't drag greedy rows through the sampler.
-        With speculation on, rows the drafter has proposals for are
-        pulled out of each partition into a verify dispatch instead
-        (consumed synchronously; they never chain). Returns the pending
-        (unsynced) window dispatches plus the pending verify dispatches;
-        ([], []) when nothing could step (no ACTIVE rows / pool dry)."""
-        cfg = self.cfg
-        ps, K = cfg.page_size, cfg.decode_window
-        greedy: list[tuple[Sequence, int, int]] = []  # (seq, wpos, cap)
-        sampler: list[tuple[Sequence, int, int]] = []
-        for seq in self.sched.slots:
-            if seq is None or seq.state is not SeqState.ACTIVE:
-                continue
-            if seq.shared_tail_pid >= 0 and not self._resolve_shared_tail(seq):
-                # The shared tail page must be private before this row's
-                # first decode write lands in it, and the COW copy found
-                # the pool dry: hard-stall the row (same grace clock as
-                # a dry page allocation).
-                seq.stalled = True
-                if not seq.stalled_since:
-                    seq.stalled_since = time.time()
-                    if self.flight is not None:
-                        self.flight.record(
-                            "stall_start", req=seq.request_id, slot=seq.slot
-                        )
-                continue
-            wpos = len(seq.tokens) - 1  # position of the token being fed
-            # Provision the whole window up front (best effort: partial
-            # allocation still lets the row run until its pages end).
-            self.sched.ensure_pages_until(seq, wpos + K - 1)
-            cap = min(cfg.max_model_len, len(seq.page_ids) * ps) - 1
-            if cap < wpos:
-                if wpos // ps >= self.kv.num_pages:
-                    # The row's own context now exceeds the ENTIRE pool:
-                    # no preemption or wait can ever feed its next token
-                    # on this engine. The pool is this deployment's hard
-                    # context capacity — close the stream with what it
-                    # has (mirrors the max_model_len LENGTH) instead of
-                    # stalling the slot forever.
-                    log.warning(
-                        "request %s reached the KV pool's context "
-                        "capacity (%d pages) at %d tokens; finishing "
-                        "with length",
-                        seq.request_id, self.kv.num_pages, wpos,
-                    )
-                    self.sched.finish(seq, FinishReason.LENGTH)
-                    continue
-                # Hard stall: the row cannot even feed its next token.
-                # Start (or keep) the preemption grace clock.
-                seq.stalled = True
-                if not seq.stalled_since:
-                    seq.stalled_since = time.time()
-                    if self.flight is not None:
-                        self.flight.record(
-                            "stall_start", req=seq.request_id, slot=seq.slot
-                        )
-                continue  # pool dry: this slot idles one window
-            seq.stalled = len(seq.page_ids) * ps < min(
-                wpos + K, cfg.max_model_len
-            )
-            if seq.stalled_since and self.flight is not None:
-                self.flight.record(
-                    "stall_end", req=seq.request_id, slot=seq.slot
-                )
-            seq.stalled_since = 0.0  # progressing (even if window-capped)
-            part = sampler if self._needs_sampler(seq) else greedy
-            part.append((seq, wpos, cap))
-        spec_parts: list[tuple[list, bool]] = []
-        if self._spec is not None:
-            greedy, g_spec = self._extract_spec_rows(greedy)
-            sampler, s_spec = self._extract_spec_rows(sampler)
-            spec_parts = [(p, fs) for p, fs in ((g_spec, False), (s_spec, True)) if p]
-            if len(self._spec) > 4 * cfg.max_decode_slots:
-                self._spec.retain(
-                    s.request_id for s in self.sched.slots if s is not None
-                )
-        spec_out = [
-            self._dispatch_spec(part, fs) for part, fs in spec_parts
-        ]
-        out: list[_PendingDecode] = []
-        # A window is chainable only when it is the iteration's single
-        # decode dispatch — a concurrent verify pass (like a second
-        # partition) means the row set will be re-planned next round.
-        solo = (bool(greedy) != bool(sampler)) and not spec_out
-        for part, full_sampler in ((greedy, False), (sampler, True)):
-            if part:
-                out.append(self._dispatch_partition(part, full_sampler, solo))
-        return out, spec_out
+    def _row_sampler_args(self, seq: Sequence, r: int, arrs: tuple) -> None:
+        """Fill row ``r`` of the per-row sampler parameter arrays
+        (seeds, temp, top_k, top_p, freq, pres, rep)."""
+        seeds, temp, top_k, top_p, freq, pres, rep = arrs
+        so = seq.stop.sampling_options
+        seeds[r] = seq.sample_seed & 0x7FFFFFFF
+        temp[r] = so.temperature if so.temperature is not None else 0.0
+        top_k[r] = so.top_k or 0
+        top_p[r] = so.top_p if so.top_p is not None else 1.0
+        freq[r] = so.frequency_penalty or 0.0
+        pres[r] = so.presence_penalty or 0.0
+        rep[r] = so.repetition_penalty or 1.0
 
     # ------------------------------------------------------------ speculation
     def _extract_spec_rows(self, part):
@@ -1949,180 +1872,6 @@ class TPUEngine(AsyncEngine):
             plain.append((seq, wpos, cap))
         return plain, spec
 
-    def _dispatch_spec(self, part, full_sampler: bool) -> _PendingSpec:
-        """Build + dispatch one batched verify pass: each row feeds its
-        last confirmed token plus its draft tokens at consecutive
-        absolute positions (one chunked-prefill-shaped dispatch per row
-        group). No host sync here; :meth:`_consume_spec` runs in the
-        same iteration."""
-        cfg = self.cfg
-        ps = cfg.page_size
-        rows = cfg.decode_rows_bucket_for(len(part))
-        kb = cfg.spec_draft_bucket_for(max(len(d) for _, _, _, d in part))
-        T = kb + 1
-        tokens = np.zeros((rows, T), np.int32)
-        positions = np.full((rows, T), -1, np.int32)
-        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
-        n_drafts = np.zeros(rows, np.int32)
-        slot_map = np.full(rows, cfg.max_decode_slots, np.int32)
-        seeds = np.zeros(rows, np.int32)
-        temp = np.zeros(rows, np.float32)
-        top_k = np.zeros(rows, np.int32)
-        top_p = np.ones(rows, np.float32)
-        freq = np.zeros(rows, np.float32)
-        pres = np.zeros(rows, np.float32)
-        rep = np.ones(rows, np.float32)
-        stepped: list[tuple[Sequence, int, int]] = []
-        max_pages = 1
-        for r, (seq, wpos, _cap, drafts) in enumerate(part):
-            g = len(drafts)
-            tokens[r, 0] = seq.last_token()
-            tokens[r, 1 : g + 1] = drafts
-            positions[r, : g + 1] = np.arange(wpos, wpos + g + 1)
-            table[r, : len(seq.page_ids)] = seq.page_ids
-            n_drafts[r] = g
-            slot_map[r] = seq.slot
-            max_pages = max(max_pages, (wpos + g) // ps + 1)
-            so = seq.stop.sampling_options
-            seeds[r] = seq.sample_seed & 0x7FFFFFFF
-            temp[r] = so.temperature if so.temperature is not None else 0.0
-            top_k[r] = so.top_k or 0
-            top_p[r] = so.top_p if so.top_p is not None else 1.0
-            freq[r] = so.frequency_penalty or 0.0
-            pres[r] = so.presence_penalty or 0.0
-            rep[r] = so.repetition_penalty or 1.0
-            stepped.append((seq, g, r))
-        want_lp = any(
-            self._wants_logprobs(seq) is not None for seq, _, _ in stepped
-        )
-        n_variants = len(self._spec_fns)
-        fn = self._spec_fn(
-            rows, kb, cfg.page_bucket_for(max_pages), full_sampler, want_lp
-        )
-        fresh = len(self._spec_fns) > n_variants
-        self._flush_offloads()
-        prof = self.profiler
-        t0 = prof.begin("spec_verify") if prof is not None else 0.0
-        if full_sampler:
-            ys, self.k_cache, self.v_cache, self._counts = fn(
-                self.params, self.k_cache, self.v_cache,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(table), jnp.asarray(n_drafts), self._counts,
-                jnp.asarray(slot_map), jnp.asarray(seeds),
-                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-                jnp.asarray(freq), jnp.asarray(pres), jnp.asarray(rep),
-            )
-        else:
-            ys, self.k_cache, self.v_cache = fn(
-                self.params, self.k_cache, self.v_cache,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(table), jnp.asarray(n_drafts),
-            )
-        dispatched_at = (
-            prof.end("spec_verify", t0, fresh) if prof is not None else 0.0
-        )
-        if self.flight is not None:
-            self.flight.record(
-                "dispatch", dispatch="spec_verify", rows=len(part), draft_bucket=kb
-            )
-        self.steps += T
-        self.spec_dispatches += 1
-        get_telemetry().decode_batch_rows.observe(len(part))
-        return _PendingSpec(
-            ys=ys,
-            stepped=stepped,
-            full_sampler=full_sampler,
-            want_lp=want_lp,
-            dispatched_at=dispatched_at,
-        )
-
-    def _consume_spec(self, pending: _PendingSpec) -> None:
-        """Host sync of one verify pass: the device already computed the
-        acceptance (longest prefix where draft == target, plus the first
-        correction token — :func:`spec_accept_length` /
-        :func:`spec_verify_tokens`, the same rule that gated the
-        on-device penalty counts); the host emits those tokens, rewinds
-        state past rejected positions, and feeds the outcome back to
-        the adaptive controller. The authoritative host ``check_stop``
-        still gates every emitted token (EOS / stop ids / budget),
-        exactly as in decode."""
-        if pending.want_lp:
-            targets, n_emits, lps, top_ids, top_lps = (
-                np.asarray(y) for y in pending.ys  # dynlint: sync-point(spec verify consume)
-            )
-        else:
-            targets = np.asarray(pending.ys[0])  # dynlint: sync-point(spec verify consume)
-            n_emits = np.asarray(pending.ys[1])  # dynlint: sync-point(spec verify consume)
-        if self.profiler is not None:
-            self.profiler.consume("spec_verify", pending.dispatched_at)
-        if self.flight is not None:
-            self.flight.record(
-                "consume", dispatch="spec_verify", rows=len(pending.stepped)
-            )
-        tel = get_telemetry()
-        for seq, g, row in pending.stepped:
-            if seq.state is not SeqState.ACTIVE or seq.pending_finish is not None:
-                continue
-            tgt = targets[row]
-            n_emit = int(n_emits[row])
-            accepted = n_emit - 1
-            kept: list[int] = []
-            reason = None
-            for i in range(n_emit):
-                token = int(tgt[i])
-                kept.append(token)
-                seq.tokens.append(token)
-                seq.generated += 1
-                reason = self.sched.check_stop(seq, token)
-                if reason is not None:
-                    break
-            if n_emit - len(kept):
-                # Tokens past a host-detected stop: computed, discarded.
-                self.wasted_steps += n_emit - len(kept)
-                tel.decode_wasted_steps.inc(n_emit - len(kept))
-            seq.spec_dispatches += 1
-            seq.spec_draft_tokens += g
-            seq.spec_accepted_tokens += accepted
-            seq.spec_emitted_tokens += len(kept)
-            self.spec_row_dispatches += 1
-            self.spec_draft_tokens += g
-            self.spec_accepted_tokens += accepted
-            self.spec_emitted_tokens += len(kept)
-            tel.spec_draft_tokens.inc(g)
-            tel.spec_accepted_tokens.inc(accepted)
-            tel.spec_tokens_per_dispatch.observe(len(kept))
-            if self.flight is not None:
-                self.flight.record(
-                    "spec_accept",
-                    req=seq.request_id,
-                    proposed=g,
-                    accepted=accepted,
-                    emitted=len(kept),
-                )
-            self._spec.record(seq, proposed=g, accepted=accepted)
-            self.sched.register_full_pages(seq)
-            n_top = self._wants_logprobs(seq)
-            pack = None
-            if n_top is not None and kept:
-                n = len(kept)
-                pack = self._lp_pack(
-                    n_top, lps[row, :n], top_ids[row, :n], top_lps[row, :n]
-                )
-            if kept:
-                now = time.time()
-                if seq.last_emit_at:
-                    tbt = max(now - seq.last_emit_at, 0.0) / len(kept)
-                    tel.time_between_tokens.observe(tbt)
-                seq.last_emit_at = now
-            seq.emit(kept, None, pack)
-            if reason is not None:
-                # No chained window can be in flight over a spec row
-                # (spec rows break the chain), so finishing — and the
-                # page release it implies — is safe right here.
-                self.sched.finish(seq, reason)
-            else:
-                self._rewind_spec_pages(seq)
-
     def _rewind_spec_pages(self, seq: Sequence) -> None:
         """Page-granular rewind after a rejection: pages provisioned for
         draft positions beyond the accepted prefix go back to the pool
@@ -2142,35 +1891,38 @@ class TPUEngine(AsyncEngine):
                     "spec_rewind", req=seq.request_id, pages=len(extra)
                 )
 
-    def _dispatch_partition(
+    # --------------------------------------------------------------- builders
+    def _build_windowed(
         self,
         part: list[tuple[Sequence, int, int]],
         full_sampler: bool,
         solo: bool,
-    ) -> _PendingDecode:
-        """Build + dispatch one compacted decode window (no host sync)."""
+    ) -> _PendingRagged:
+        """Build + dispatch one pure-decode windowed batch (no host
+        sync): ``decode_window`` on-device steps over the compacted
+        rows — the ragged family's one-query-per-row shape."""
         cfg = self.cfg
         ps, K, S = cfg.page_size, cfg.decode_window, cfg.device_stop_width
-        rows = cfg.decode_rows_bucket_for(len(part))
-        tokens = np.zeros(rows, np.int32)
-        positions = np.full(rows, -1, np.int32)
-        max_pos = np.full(rows, -1, np.int32)
-        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
+        nb = cfg.ragged_tokens_bucket_for(len(part))
+        tokens = np.zeros(nb, np.int32)
+        positions = np.full(nb, -1, np.int32)
+        max_pos = np.full(nb, -1, np.int32)
+        table = np.zeros((nb, cfg.max_pages_per_seq), np.int32)
         # Pad rows map to the scratch counts row (B) so their scatter
         # can't touch a live slot.
-        slot_map = np.full(rows, cfg.max_decode_slots, np.int32)
-        stop_set = np.full((rows, S), -1, np.int32)
-        eos_gate = np.zeros(rows, np.int32)
-        budget_gate = np.full(rows, K, np.int32)  # pad: never fires
-        seeds = np.zeros(rows, np.int32)
-        temp = np.zeros(rows, np.float32)
-        top_k = np.zeros(rows, np.int32)
-        top_p = np.ones(rows, np.float32)
-        freq = np.zeros(rows, np.float32)
-        pres = np.zeros(rows, np.float32)
-        rep = np.ones(rows, np.float32)
+        slot_map = np.full(nb, cfg.max_decode_slots, np.int32)
+        stop_set = np.full((nb, S), -1, np.int32)
+        eos_gate = np.zeros(nb, np.int32)
+        budget_gate = np.full(nb, K, np.int32)  # pad: never fires
+        seeds = np.zeros(nb, np.int32)
+        temp = np.zeros(nb, np.float32)
+        top_k = np.zeros(nb, np.int32)
+        top_p = np.ones(nb, np.float32)
+        freq = np.zeros(nb, np.float32)
+        pres = np.zeros(nb, np.float32)
+        rep = np.ones(nb, np.float32)
 
-        stepped: list[tuple[Sequence, int, int]] = []
+        rows: list[_RaggedRow] = []
         max_pages = 1
         capacity_capped = False
         for r, (seq, wpos, cap) in enumerate(part):
@@ -2184,27 +1936,25 @@ class TPUEngine(AsyncEngine):
             stops = self._stop_set(seq)
             stop_set[r, : len(stops)] = stops
             eos_gate[r], budget_gate[r] = self._stop_gates(seq, seq.generated)
-            so = seq.stop.sampling_options
-            seeds[r] = seq.sample_seed & 0x7FFFFFFF
-            temp[r] = so.temperature if so.temperature is not None else 0.0
-            top_k[r] = so.top_k or 0
-            top_p[r] = so.top_p if so.top_p is not None else 1.0
-            freq[r] = so.frequency_penalty or 0.0
-            pres[r] = so.presence_penalty or 0.0
-            rep[r] = so.repetition_penalty or 1.0
-            stepped.append((seq, min(K, cap - wpos + 1), r))
+            self._row_sampler_args(
+                seq, r, (seeds, temp, top_k, top_p, freq, pres, rep)
+            )
+            rows.append(
+                _RaggedRow(seq, "decode", r, n_valid=min(K, cap - wpos + 1))
+            )
 
         want_lp = any(
-            self._wants_logprobs(seq) is not None for seq, _, _ in stepped
+            self._wants_logprobs(e.seq) is not None for e in rows
         )
-        n_variants = len(self._decode_fns)
-        fn = self._decode_fn(
-            rows, cfg.page_bucket_for(max_pages), full_sampler, want_lp
+        n_variants = len(self._ragged_fns)
+        fn = self._ragged_fn(
+            nb, cfg.ragged_page_bucket_for(max_pages), True, full_sampler,
+            want_lp,
         )
-        fresh = len(self._decode_fns) > n_variants
+        fresh = len(self._ragged_fns) > n_variants
         self._flush_offloads()
         prof = self.profiler
-        t0 = prof.begin("decode") if prof is not None else 0.0
+        t0 = prof.begin("ragged") if prof is not None else 0.0
         sampler_args = (seeds, temp, top_k, top_p, freq, pres, rep)
         if full_sampler:
             (ys, self.k_cache, self.v_cache, self._counts,
@@ -2227,23 +1977,28 @@ class TPUEngine(AsyncEngine):
                 jnp.asarray(budget_gate),
             )
         dispatched_at = (
-            prof.end("decode", t0, fresh) if prof is not None else 0.0
+            prof.end("ragged", t0, fresh) if prof is not None else 0.0
         )
         if self.flight is not None:
             self.flight.record(
-                "dispatch", dispatch="decode", rows=len(part), bucket=rows
+                "dispatch",
+                dispatch="ragged",
+                rows=len(part),
+                bucket=nb,
+                windowed=True,
             )
         self.steps += K
         get_telemetry().decode_batch_rows.observe(len(part))
-        return _PendingDecode(
+        return _PendingRagged(
             ys=ys,
-            tokens_dev=tok_dev,
-            positions_dev=pos_dev,
-            stepped=stepped,
             rows=rows,
+            nb=nb,
+            windowed=True,
             full_sampler=full_sampler,
             want_lp=want_lp,
             solo=solo,
+            tokens_dev=tok_dev,
+            positions_dev=pos_dev,
             capacity_capped=capacity_capped,
             stop_tokens=stop_set,
             sampler_args=sampler_args if full_sampler else None,
@@ -2251,13 +2006,215 @@ class TPUEngine(AsyncEngine):
             dispatched_at=dispatched_at,
         )
 
+    def _build_mixed(
+        self,
+        dec: list[tuple[Sequence, int, int]],
+        spec: list[tuple],
+        pf: list[Sequence],
+        full_sampler: bool,
+    ) -> _PendingRagged:
+        """Build + dispatch one mixed ragged batch (no host sync): a
+        flat query stream carrying each prefill row's next chunk, each
+        decode row's fed token, and each speculative row's
+        last-token + drafts span, over one compiled ragged program.
+        Decode rows advance one step here (the window resumes once the
+        batch is pure decode again); prompts completing this chunk
+        sample their first token in the same dispatch."""
+        cfg = self.cfg
+        ps = cfg.page_size
+        B1 = cfg.max_decode_slots + 1
+        T_s = cfg.spec_max_draft + 1
+        align = self._ragged_align()
+        flat_tokens: list[int] = []
+        flat_pos: list[int] = []
+        flat_row: list[int] = []
+
+        table = np.zeros((B1, cfg.max_pages_per_seq), np.int32)
+        q_last = np.zeros(B1, np.int32)
+        pos0 = np.full(B1, -1, np.int32)
+        is_decode = np.zeros(B1, np.bool_)
+        slot_map = np.full(B1, cfg.max_decode_slots, np.int32)
+        seeds = np.zeros(B1, np.int32)
+        temp = np.zeros(B1, np.float32)
+        top_k = np.zeros(B1, np.int32)
+        top_p = np.ones(B1, np.float32)
+        freq = np.zeros(B1, np.float32)
+        pres = np.zeros(B1, np.float32)
+        rep = np.ones(B1, np.float32)
+        spec_idx = np.zeros((B1, T_s), np.int32)
+        spec_pos = np.full((B1, T_s), -1, np.int32)
+        spec_drafts = np.full((B1, max(T_s - 1, 1)), -1, np.int32)
+        n_drafts = np.zeros(B1, np.int32)
+        sampler_arrs = (seeds, temp, top_k, top_p, freq, pres, rep)
+
+        def add_span(toks: list[int], poss: list[int], r: int) -> int:
+            """Append one row's query span to the flat stream, aligned
+            to the kernel's q_tile (padding positions are -1: their
+            writes drop and their scores mask out)."""
+            start = len(flat_tokens)
+            flat_tokens.extend(toks)
+            flat_pos.extend(poss)
+            flat_row.extend([r] * len(toks))
+            pad = (-len(toks)) % align
+            if pad:
+                flat_tokens.extend([0] * pad)
+                flat_pos.extend([-1] * pad)
+                flat_row.extend([r] * pad)
+            return start
+
+        rows: list[_RaggedRow] = []
+        max_pages = 1
+        r = 0
+        for seq in pf:
+            self._apply_uploads(seq)
+            n = min(len(seq.prompt) - seq.prefill_sent, cfg.prefill_chunk)
+            start_tok = seq.prefill_sent
+            qs = add_span(
+                list(seq.prompt[start_tok : start_tok + n]),
+                list(range(start_tok, start_tok + n)),
+                r,
+            )
+            seq.prefill_sent = start_tok + n
+            table[r, : len(seq.page_ids)] = seq.page_ids
+            q_last[r] = qs + n - 1
+            # Key the first-token draw by the absolute position of the
+            # prompt's last token — identical to the draw a decode
+            # window would make feeding that token, so prefill chunking
+            # and continuation re-prefills replay the same sample.
+            pos0[r] = start_tok + n - 1
+            max_pages = max(max_pages, (seq.prefill_sent + ps - 1) // ps)
+            self._row_sampler_args(seq, r, sampler_arrs)
+            rows.append(
+                _RaggedRow(
+                    seq,
+                    "prefill",
+                    r,
+                    completing=seq.prefill_sent == len(seq.prompt),
+                )
+            )
+            r += 1
+        for seq, wpos, _cap in dec:
+            qs = add_span([seq.last_token()], [wpos], r)
+            table[r, : len(seq.page_ids)] = seq.page_ids
+            q_last[r] = qs
+            pos0[r] = wpos
+            is_decode[r] = True
+            slot_map[r] = seq.slot
+            max_pages = max(max_pages, wpos // ps + 1)
+            self._row_sampler_args(seq, r, sampler_arrs)
+            rows.append(_RaggedRow(seq, "decode", r, n_valid=1))
+            r += 1
+        for seq, wpos, _cap, drafts in spec:
+            g = len(drafts)
+            qs = add_span(
+                [seq.last_token()] + list(drafts),
+                list(range(wpos, wpos + g + 1)),
+                r,
+            )
+            table[r, : len(seq.page_ids)] = seq.page_ids
+            q_last[r] = qs + g
+            slot_map[r] = seq.slot
+            spec_idx[r, : g + 1] = qs + np.arange(g + 1)
+            spec_pos[r, : g + 1] = np.arange(wpos, wpos + g + 1)
+            spec_drafts[r, :g] = drafts
+            n_drafts[r] = g
+            max_pages = max(max_pages, (wpos + g) // ps + 1)
+            self._row_sampler_args(seq, r, sampler_arrs)
+            rows.append(_RaggedRow(seq, "spec", r, n_drafts=g))
+            r += 1
+
+        total_q = len(flat_tokens)
+        nb = cfg.ragged_tokens_bucket_for(max(total_q, 1), mixed=True)
+        tokens = np.zeros(nb, np.int32)
+        positions = np.full(nb, -1, np.int32)
+        # Flat padding maps to the scratch per-row index (B1 - 1 is
+        # always free: at most max_decode_slots rows hold slots).
+        row_of = np.full(nb, B1 - 1, np.int32)
+        tokens[:total_q] = flat_tokens
+        positions[:total_q] = flat_pos
+        row_of[:total_q] = flat_row
+
+        want_lp = any(
+            self._wants_logprobs(e.seq) is not None for e in rows
+        )
+        with_spec = bool(spec)
+        n_variants = len(self._ragged_fns)
+        fn = self._ragged_fn(
+            nb, cfg.ragged_page_bucket_for(max_pages), False, full_sampler,
+            want_lp, with_spec,
+        )
+        fresh = len(self._ragged_fns) > n_variants
+        self._flush_offloads()
+        prof = self.profiler
+        t0 = prof.begin("ragged") if prof is not None else 0.0
+        if full_sampler:
+            ys, self.k_cache, self.v_cache, self._counts = fn(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(row_of), jnp.asarray(table),
+                jnp.asarray(q_last), jnp.asarray(pos0), self._counts,
+                jnp.asarray(slot_map), jnp.asarray(is_decode),
+                jnp.asarray(seeds), jnp.asarray(temp), jnp.asarray(top_k),
+                jnp.asarray(top_p), jnp.asarray(freq), jnp.asarray(pres),
+                jnp.asarray(rep), jnp.asarray(spec_idx),
+                jnp.asarray(spec_pos), jnp.asarray(spec_drafts),
+                jnp.asarray(n_drafts),
+            )
+        else:
+            ys, self.k_cache, self.v_cache = fn(
+                self.params, self.k_cache, self.v_cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(row_of), jnp.asarray(table),
+                jnp.asarray(q_last), jnp.asarray(spec_idx),
+                jnp.asarray(spec_drafts), jnp.asarray(n_drafts),
+            )
+        dispatched_at = (
+            prof.end("ragged", t0, fresh) if prof is not None else 0.0
+        )
+        if self.flight is not None:
+            self.flight.record(
+                "dispatch",
+                dispatch="ragged",
+                rows=len(rows),
+                tokens=total_q,
+                bucket=nb,
+                windowed=False,
+            )
+        # Pages this batch's chunks fully covered are now filled *in
+        # dispatch order*: sharers gated on them may dispatch reads
+        # from the next iteration on (docs/prefix_sharing.md).
+        newly_filled: list[int] = []
+        for seq in pf:
+            n_full = seq.prefill_sent // ps
+            if n_full > seq.fill_marked:
+                newly_filled.extend(seq.page_ids[seq.fill_marked : n_full])
+                seq.fill_marked = n_full
+        if newly_filled:
+            self.kv.mark_filled(newly_filled)
+        self.steps += 1
+        if spec:
+            self.spec_dispatches += 1
+        get_telemetry().decode_batch_rows.observe(len(dec) + len(spec))
+        return _PendingRagged(
+            ys=ys,
+            rows=rows,
+            nb=nb,
+            windowed=False,
+            full_sampler=full_sampler,
+            want_lp=want_lp,
+            solo=False,
+            with_spec=with_spec,
+            dispatched_at=dispatched_at,
+        )
+
+    # ---------------------------------------------------------------- chaining
     def _can_chain(self) -> bool:
         """Whether the next window may launch straight from the inflight
         window's device carry, before the host syncs. Requires a stable
         steady state: nothing waiting or prefilling, no cancellations,
-        a single (solo) partition, and at least one row the host knows
-        will outlive the inflight window (otherwise the chained window
-        would compute only discards)."""
+        a single (solo, windowed) dispatch, and at least one row the
+        host knows will outlive the inflight window (otherwise the
+        chained window would compute only discards)."""
         p = self._inflight
         if p is None or not p.solo or not self.cfg.chained_decode:
             return False
@@ -2272,10 +2229,12 @@ class TPUEngine(AsyncEngine):
             # and the drafter must re-plan from the freshly consumed
             # tokens each round. Rows whose drafting is backed off
             # (lookup keeps missing) chain normally.
-            for s, _, _ in p.stepped:
-                if s.state is SeqState.ACTIVE and self._spec.wants_draft(s):
+            for e in p.rows:
+                if e.seq.state is SeqState.ACTIVE and self._spec.wants_draft(
+                    e.seq
+                ):
                     return False
-        stepped_seqs = {id(seq) for seq, _, _ in p.stepped}
+        stepped_seqs = {id(e.seq) for e in p.rows}
         now = time.time()
         for s in self.sched.slots:
             if s is None:
@@ -2292,16 +2251,16 @@ class TPUEngine(AsyncEngine):
                 # would starve it — rebuild a fresh compacted window.
                 return False
         K = self.cfg.decode_window
-        for seq, n_valid, _ in p.stepped:
-            sc = seq.stop.stop_conditions
+        for e in p.rows:
+            sc = e.seq.stop.stop_conditions
             max_tokens = sc.max_tokens or self.cfg.default_max_tokens
-            if n_valid >= K and max_tokens - seq.generated > K:
+            if e.n_valid >= K and max_tokens - e.seq.generated > K:
                 return True  # a survivor makes the chained window useful
         return False
 
     def _dispatch_chained(
-        self, pending: _PendingDecode
-    ) -> _PendingDecode | None:
+        self, pending: _PendingRagged
+    ) -> _PendingRagged | None:
         """Dispatch window N+1 over window N's rows using N's on-device
         carry (tokens/positions) as inputs — no host round-trip. The
         host view of these rows lags one window: positions advance by
@@ -2312,16 +2271,17 @@ class TPUEngine(AsyncEngine):
         cover a row."""
         cfg = self.cfg
         ps, K = cfg.page_size, cfg.decode_window
-        rows = pending.rows
-        max_pos = np.full(rows, -1, np.int32)
-        table = np.zeros((rows, cfg.max_pages_per_seq), np.int32)
+        nb = pending.nb
+        max_pos = np.full(nb, -1, np.int32)
+        table = np.zeros((nb, cfg.max_pages_per_seq), np.int32)
         stop_set = pending.stop_tokens  # same rows, same stop sets
-        eos_gate = np.zeros(rows, np.int32)
-        budget_gate = np.full(rows, K, np.int32)
-        stepped: list[tuple[Sequence, int, int]] = []
+        eos_gate = np.zeros(nb, np.int32)
+        budget_gate = np.full(nb, K, np.int32)
+        rows: list[_RaggedRow] = []
         max_pages = 1
         capacity_capped = False
-        for seq, _, r in pending.stepped:
+        for e in pending.rows:
+            seq, r = e.seq, e.row
             wpos = len(seq.tokens) - 1 + K  # host view + inflight window
             self.sched.ensure_pages_until(seq, wpos + K - 1)
             cap = min(cfg.max_model_len, len(seq.page_ids) * ps) - 1
@@ -2334,18 +2294,21 @@ class TPUEngine(AsyncEngine):
             eos_gate[r], budget_gate[r] = self._stop_gates(
                 seq, seq.generated + K
             )
-            stepped.append((seq, min(K, cap - wpos + 1), r))
-        n_variants = len(self._decode_fns)
-        fn = self._decode_fn(  # dynlint: recompile-hazard(chained window reuses the dispatched bucket)
-            rows,
-            cfg.page_bucket_for(max_pages),
+            rows.append(
+                _RaggedRow(seq, "decode", r, n_valid=min(K, cap - wpos + 1))
+            )
+        n_variants = len(self._ragged_fns)
+        fn = self._ragged_fn(  # dynlint: recompile-hazard(chained window reuses the dispatched bucket)
+            nb,
+            cfg.ragged_page_bucket_for(max_pages),
+            True,
             pending.full_sampler,
             pending.want_lp,
         )
-        fresh = len(self._decode_fns) > n_variants
+        fresh = len(self._ragged_fns) > n_variants
         self._flush_offloads()
         prof = self.profiler
-        t0 = prof.begin("decode") if prof is not None else 0.0
+        t0 = prof.begin("ragged") if prof is not None else 0.0
         if pending.full_sampler:
             seeds, temp, top_k, top_p, freq, pres, rep = pending.sampler_args
             (ys, self.k_cache, self.v_cache, self._counts,
@@ -2368,27 +2331,29 @@ class TPUEngine(AsyncEngine):
                 jnp.asarray(budget_gate),
             )
         dispatched_at = (
-            prof.end("decode", t0, fresh) if prof is not None else 0.0
+            prof.end("ragged", t0, fresh) if prof is not None else 0.0
         )
         if self.flight is not None:
             self.flight.record(
                 "dispatch",
-                dispatch="decode",
-                rows=len(stepped),
-                bucket=rows,
+                dispatch="ragged",
+                rows=len(rows),
+                bucket=nb,
+                windowed=True,
                 chained=True,
             )
         self.steps += K
-        get_telemetry().decode_batch_rows.observe(len(stepped))
-        return _PendingDecode(
+        get_telemetry().decode_batch_rows.observe(len(rows))
+        return _PendingRagged(
             ys=ys,
-            tokens_dev=tok_dev,
-            positions_dev=pos_dev,
-            stepped=stepped,
             rows=rows,
+            nb=nb,
+            windowed=True,
             full_sampler=pending.full_sampler,
             want_lp=pending.want_lp,
             solo=True,
+            tokens_dev=tok_dev,
+            positions_dev=pos_dev,
             capacity_capped=capacity_capped,
             stop_tokens=stop_set,
             sampler_args=pending.sampler_args,
@@ -2396,28 +2361,35 @@ class TPUEngine(AsyncEngine):
             dispatched_at=dispatched_at,
         )
 
-    def _consume_decode(self, pending: _PendingDecode) -> None:
+    # ----------------------------------------------------------------- consume
+    def _consume_ragged(self, pending: _PendingRagged) -> None:
+        if pending.windowed:
+            self._consume_windowed(pending)
+        else:
+            self._consume_mixed(pending)
+
+    def _consume_windowed(self, pending: _PendingRagged) -> None:
         """Host sync of one decode window: emit kept tokens, run the
         authoritative check_stop, register completed pages. A stop found
         while a chained successor is still in flight defers the finish
         (page release) until that successor is force-consumed — the
         device already parked the row at position -1, so the successor
         writes nothing for it."""
-        K = self.cfg.decode_window
         if pending.want_lp:
             sampled, lps, top_ids, top_lps = (
-                np.asarray(y) for y in pending.ys  # dynlint: sync-point(decode window consume)
+                np.asarray(y) for y in pending.ys  # dynlint: sync-point(ragged consume)
             )
         else:
-            sampled = np.asarray(pending.ys[0])  # dynlint: sync-point(decode window consume)
+            sampled = np.asarray(pending.ys[0])  # dynlint: sync-point(ragged consume)
         if self.profiler is not None:
             # The np.asarray above was this window's one host sync.
-            self.profiler.consume("decode", pending.dispatched_at)
+            self.profiler.consume("ragged", pending.dispatched_at)
         tel = get_telemetry()
         finishes: list[Sequence] = []
         wasted = 0
         emitted = 0
-        for seq, n_valid, row in pending.stepped:
+        for e in pending.rows:
+            seq, n_valid, row = e.seq, e.n_valid, e.row
             if seq.state is not SeqState.ACTIVE or seq.pending_finish is not None:
                 wasted += n_valid  # whole window past this row's stop
                 continue
@@ -2456,7 +2428,7 @@ class TPUEngine(AsyncEngine):
                 finishes.append(seq)
         if self.flight is not None:
             self.flight.record(
-                "consume", dispatch="decode", tokens=emitted, wasted=wasted
+                "consume", dispatch="ragged", tokens=emitted, wasted=wasted
             )
         if wasted:
             self.wasted_steps += wasted
@@ -2468,10 +2440,147 @@ class TPUEngine(AsyncEngine):
             # pending finish are skipped above).
             succ, self._inflight = self._inflight, None
             if succ is not None:
-                self._consume_decode(succ)
+                self._consume_ragged(succ)
             for seq in finishes:
                 reason, seq.pending_finish = seq.pending_finish, None
                 self.sched.finish(seq, reason)
+
+    def _consume_mixed(self, pending: _PendingRagged) -> None:
+        """Host sync of one mixed ragged batch: decode rows emit their
+        one stepped token, prompts that completed their last chunk emit
+        their first token and join decode, speculative rows emit the
+        device-computed accepted prefix + correction (and rewind state
+        past rejections), and prompts mid-chunking emit nothing. The
+        authoritative host ``check_stop`` gates every emitted token.
+        Mixed batches are never chained over, so finishes (and their
+        page releases) are safe immediately."""
+        spec_on = pending.with_spec
+        ys = [np.asarray(y) for y in pending.ys]  # dynlint: sync-point(ragged consume)
+        tok0 = ys[0]
+        i = 1
+        if spec_on:
+            targets, n_emits = ys[i], ys[i + 1]
+            i += 2
+        if pending.want_lp:
+            lp0, tid0, tlp0 = ys[i], ys[i + 1], ys[i + 2]
+            i += 3
+            if spec_on:
+                s_lps, s_tids, s_tlps = ys[i], ys[i + 1], ys[i + 2]
+        if self.profiler is not None:
+            self.profiler.consume("ragged", pending.dispatched_at)
+        if self.flight is not None:
+            self.flight.record(
+                "consume", dispatch="ragged", rows=len(pending.rows)
+            )
+        tel = get_telemetry()
+        for e in pending.rows:
+            seq, r = e.seq, e.row
+            if e.kind == "prefill":
+                if not e.completing:
+                    continue
+                n_top = self._wants_logprobs(seq)
+                pack = (
+                    self._lp_pack(
+                        n_top, lp0[r : r + 1],
+                        tid0[r : r + 1], tlp0[r : r + 1],
+                    )
+                    if pending.want_lp and n_top is not None
+                    else None
+                )
+                self._finish_first_token(seq, int(tok0[r]), pack)
+                continue
+            if seq.state is not SeqState.ACTIVE or seq.pending_finish is not None:
+                self.wasted_steps += 1
+                tel.decode_wasted_steps.inc()
+                continue
+            if e.kind == "decode":
+                token = int(tok0[r])
+                seq.tokens.append(token)
+                seq.generated += 1
+                reason = self.sched.check_stop(seq, token)
+                self.sched.register_full_pages(seq)
+                n_top = self._wants_logprobs(seq)
+                pack = (
+                    self._lp_pack(
+                        n_top, lp0[r : r + 1],
+                        tid0[r : r + 1], tlp0[r : r + 1],
+                    )
+                    if pending.want_lp and n_top is not None
+                    else None
+                )
+                now = time.time()
+                if seq.last_emit_at:
+                    tel.time_between_tokens.observe(
+                        max(now - seq.last_emit_at, 0.0)
+                    )
+                seq.last_emit_at = now
+                seq.emit([token], None, pack)
+                if reason is not None:
+                    self.sched.finish(seq, reason)
+                continue
+            # Speculative row: the device already computed the
+            # acceptance (longest draft == target prefix plus the first
+            # correction token — the same rule that gated the on-device
+            # penalty counts); emit those tokens, rewind state past
+            # rejected positions, and feed the outcome back to the
+            # adaptive controller.
+            g = e.n_drafts
+            tgt = targets[r]
+            n_emit = int(n_emits[r])
+            accepted = n_emit - 1
+            kept: list[int] = []
+            reason = None
+            for j in range(n_emit):
+                token = int(tgt[j])
+                kept.append(token)
+                seq.tokens.append(token)
+                seq.generated += 1
+                reason = self.sched.check_stop(seq, token)
+                if reason is not None:
+                    break
+            if n_emit - len(kept):
+                # Tokens past a host-detected stop: computed, discarded.
+                self.wasted_steps += n_emit - len(kept)
+                tel.decode_wasted_steps.inc(n_emit - len(kept))
+            seq.spec_dispatches += 1
+            seq.spec_draft_tokens += g
+            seq.spec_accepted_tokens += accepted
+            seq.spec_emitted_tokens += len(kept)
+            self.spec_row_dispatches += 1
+            self.spec_draft_tokens += g
+            self.spec_accepted_tokens += accepted
+            self.spec_emitted_tokens += len(kept)
+            tel.spec_draft_tokens.inc(g)
+            tel.spec_accepted_tokens.inc(accepted)
+            tel.spec_tokens_per_dispatch.observe(len(kept))
+            if self.flight is not None:
+                self.flight.record(
+                    "spec_accept",
+                    req=seq.request_id,
+                    proposed=g,
+                    accepted=accepted,
+                    emitted=len(kept),
+                )
+            self._spec.record(seq, proposed=g, accepted=accepted)
+            self.sched.register_full_pages(seq)
+            n_top = self._wants_logprobs(seq)
+            pack = None
+            if n_top is not None and kept:
+                n = len(kept)
+                pack = self._lp_pack(
+                    n_top, s_lps[r, :n], s_tids[r, :n], s_tlps[r, :n]
+                )
+            if kept:
+                now = time.time()
+                if seq.last_emit_at:
+                    tbt = max(now - seq.last_emit_at, 0.0) / len(kept)
+                    tel.time_between_tokens.observe(tbt)
+                seq.last_emit_at = now
+            seq.emit(kept, None, pack)
+            if reason is not None:
+                self.sched.finish(seq, reason)
+            else:
+                self._rewind_spec_pages(seq)
 
     # --------------------------------------------------------------- metrics
     def metrics(self) -> dict:
@@ -2496,8 +2605,9 @@ class TPUEngine(AsyncEngine):
         m["kv_prefix_hits_shared"] = self.kv.prefix_hits["shared"]
         m["kv_prefix_hits_restore"] = self.kv.prefix_hits["restore"]
         m["kv_prefix_hits_miss"] = self.kv.prefix_hits["miss"]
-        m["compiled_decode_variants"] = len(self._decode_fns)
-        m["compiled_prefill_variants"] = len(self._prefill_fns)
+        # The ONE ragged variant cache (docs/engine_perf.md "One
+        # ragged dispatch") replaces the old per-family mirrors.
+        m["compiled_ragged_variants"] = len(self._ragged_fns)
         # Per-dispatch profiler mirror (docs/observability.md): per-kind
         # host-gap / in-flight percentiles over the recent window plus
         # compile attribution — the same numbers the dynamo_dispatch_*
@@ -2520,7 +2630,6 @@ class TPUEngine(AsyncEngine):
         m["spec_draft_tokens"] = self.spec_draft_tokens
         m["spec_accepted_tokens"] = self.spec_accepted_tokens
         m["spec_emitted_tokens"] = self.spec_emitted_tokens
-        m["compiled_spec_variants"] = len(self._spec_fns)
         if self.host_pool is not None:
             m["host_cache_resident"] = self.host_pool.resident
             m["host_cache_hits"] = self.host_pool.hits

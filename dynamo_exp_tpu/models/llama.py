@@ -306,6 +306,15 @@ def _final_logits(params, cfg, x, eps):
     x = rms_norm(x, params["final_norm"], eps, cfg.rms_norm_offset)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = (x @ head).astype(jnp.float32)
+    if head.dtype == jnp.bfloat16:
+        # Pin the logits to bf16 precision even inside a fused compiled
+        # program: XLA's excess-precision rules may otherwise elide the
+        # bf16 rounding between the matmul and a fused argmax/sampler,
+        # silently un-tying exactly-tied bf16 logits — and greedy
+        # identity across dispatch layouts (eager oracle, windowed
+        # window, mixed ragged batch) depends on every layout rounding
+        # the distribution identically before the tie-break.
+        logits = jax.lax.reduce_precision(logits, 8, 7)
     if cfg.final_logit_softcap is not None:  # gemma2
         cap = cfg.final_logit_softcap
         logits = jnp.tanh(logits / cap) * cap
@@ -349,10 +358,11 @@ def forward(
     reads only the first ``attn_pages`` table columns, so short contexts
     don't pay Pmax-wide HBM traffic. K/V *writes* always use the full
     table. ``attn_impl="pallas"`` switches decode (T==1) to the ragged
-    Pallas kernel (``ops/paged_decode.py``), which reads each sequence's
-    true context length — ``attn_pages`` is then irrelevant. With a
-    ``mesh`` whose ``tp`` axis is >1, the kernel runs under ``shard_map``
-    over the head axis (attention is embarrassingly parallel in heads).
+    Pallas kernel (``ops/ragged_attention.py``, at its one-query-per-row
+    shape), which reads each sequence's true context length —
+    ``attn_pages`` is then irrelevant. With a ``mesh`` whose ``tp`` axis
+    is >1, the kernel runs under ``shard_map`` over the head axis
+    (attention is embarrassingly parallel in heads).
     """
     B, T = tokens.shape
     hd = cfg.head_dim_
@@ -484,18 +494,19 @@ def forward(
 
 
 def _pallas_decode(q, kp, vp, page_table, lengths, hkv, mesh, interpret):
-    """Dispatch the ragged decode kernel, sharded over tp when the mesh
-    has a tp axis wider than 1 (heads are embarrassingly parallel, so the
-    per-shard kernel sees its local heads and the full page pool rows for
-    them — no collectives). The pool's fused Hkv*D lane dim shards on
-    head boundaries (consecutive D-blocks per head)."""
+    """Dispatch the ragged kernel at its decode shape (one query per
+    row), sharded over tp when the mesh has a tp axis wider than 1
+    (heads are embarrassingly parallel, so the per-shard kernel sees its
+    local heads and the full page pool rows for them — no collectives).
+    The pool's fused Hkv*D lane dim shards on head boundaries
+    (consecutive D-blocks per head)."""
     from functools import partial as _partial
 
-    from ..ops.paged_decode import paged_decode_attention
+    from ..ops.ragged_attention import ragged_decode_attention
 
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     if tp <= 1:
-        return paged_decode_attention(
+        return ragged_decode_attention(
             q, kp, vp, page_table, lengths, num_kv_heads=hkv,
             interpret=interpret,
         )
@@ -515,12 +526,197 @@ def _pallas_decode(q, kp, vp, page_table, lengths, hkv, mesh, interpret):
         check_vma=False,
     )
     def f(q_l, k_l, v_l, table, lens):
-        return paged_decode_attention(
+        return ragged_decode_attention(
             q_l, k_l, v_l, table, lens, num_kv_heads=hkv // tp,
             interpret=interpret,
         )
 
     return f(q, kp, vp, page_table, lengths)
+
+
+def _pallas_ragged(
+    q, kp, vp, attn_table, row_of, positions, hkv, q_tile, mesh, interpret
+):
+    """Dispatch the ragged kernel over a flat mixed query stream,
+    sharded over tp exactly like :func:`_pallas_decode` (the kernel is
+    per-head data-parallel; each shard sees its local heads)."""
+    from functools import partial as _partial
+
+    from ..ops.ragged_attention import ragged_paged_attention
+
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp <= 1:
+        return ragged_paged_attention(
+            q, kp, vp, attn_table, row_of, positions, num_kv_heads=hkv,
+            q_tile=q_tile, interpret=interpret,
+        )
+    from ..parallel.mesh import shard_map
+
+    @_partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),
+            P(None, None, "tp"),
+            P(None, None, "tp"),
+            P(None, None),
+            P(None),
+            P(None),
+        ),
+        out_specs=P(None, "tp", None),
+        check_vma=False,
+    )
+    def f(q_l, k_l, v_l, table, rows, pos):
+        return ragged_paged_attention(
+            q_l, k_l, v_l, table, rows, pos, num_kv_heads=hkv // tp,
+            q_tile=q_tile, interpret=interpret,
+        )
+
+    return f(q, kp, vp, attn_table, row_of, positions)
+
+
+def forward_ragged(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [N] int32 flat query stream (0 where pos < 0)
+    positions: jnp.ndarray,  # [N] int32 absolute positions, -1 = padding
+    row_of: jnp.ndarray,  # [N] int32 owning batch row per token
+    page_table: jnp.ndarray,  # [R, Pmax] int32
+    k_cache: jnp.ndarray,  # [L, P, ps, Hkv*D]
+    v_cache: jnp.ndarray,
+    out_idx: jnp.ndarray,  # [M] int32 flat indices projected to logits
+    *,
+    attn_pages: int | None = None,
+    attn_impl: str = "xla",
+    q_tile: int = 8,
+    mesh=None,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One ragged forward over a flat mixed batch (the single-dispatch
+    prefill+decode+spec path, docs/engine_perf.md).
+
+    Every non-attention op is per-token, so the whole transformer runs
+    on the flattened ``[N]`` stream — chunked-prefill rows, decode rows,
+    and spec-verify rows each contribute their true query tokens, and
+    compute tracks ``N`` (the bucketed total), never ``rows x chunk``.
+    Attention is the ragged paged kernel (``ops/ragged_attention.py``)
+    or its pure-JAX reference; K/V for every valid token is written to
+    its row's pages first (write-then-gather), exactly like
+    :func:`forward`.
+
+    ``out_idx`` picks the flat positions that reach the vocab
+    projection (each row's sampling position(s)): the lm_head runs on
+    ``M`` tokens, not ``N``, so a 512-token chunk still pays one row of
+    logits. Returns (logits [M, V] float32, new_k, new_v).
+
+    Sliding-window / softcapped / query-scaled models (gemma2, mistral)
+    follow the same per-layer machinery as :func:`forward`; the Pallas
+    path is only legal when none of those are set (the engine's attn
+    resolution enforces it, mirroring ``forward``'s ``use_pallas``
+    guard).
+    """
+    N = tokens.shape[0]
+    hd = cfg.head_dim_
+    ps = k_cache.shape[2]
+    eps = cfg.rms_norm_eps
+    inv_freq = rope_frequencies(hd, cfg.rope_theta, cfg.rope_scaling)
+
+    # Page-write coordinates: each flat token writes its row's page at
+    # its own position; padding (-1) and table-overflow positions are
+    # dropped, never clamped into another row's pages.
+    safe_pos = jnp.maximum(positions, 0)
+    page_in_seq = safe_pos // ps
+    valid = (positions >= 0) & (page_in_seq < page_table.shape[1])
+    page_ids = page_table[row_of, page_in_seq]  # [N]
+    offsets = safe_pos % ps
+
+    x = jnp.take(params["embed"], tokens, axis=0)[None]  # [1, N, D]
+    x = _maybe_scale_embeds(cfg, x)
+    rope_pos = jnp.maximum(positions, 0)[None]  # [1, N]
+
+    attn_table = (
+        page_table if attn_pages is None else page_table[:, :attn_pages]
+    )
+    # Same gate as forward()'s use_pallas: window/softcap/query-scale
+    # live on the reference path, and a tp that doesn't divide the kv
+    # heads (gemma's Hkv=1 with tp>1) would leave some shard_map ranks
+    # with zero heads.
+    tp_size = mesh.shape.get("tp", 1) if mesh is not None else 1
+    use_pallas = (
+        attn_impl == "pallas"
+        and cfg.sliding_window is None
+        and cfg.attn_logit_softcap is None
+        and cfg.query_pre_attn_scalar is None
+        and cfg.num_kv_heads % tp_size == 0
+    )
+    sm_scale = (
+        cfg.query_pre_attn_scalar ** -0.5
+        if cfg.query_pre_attn_scalar
+        else None
+    )
+    # Per-layer sliding windows / rope bases ride the scan exactly as
+    # in forward() (gemma2/gemma3/mistral layer alternation).
+    have_window = cfg.sliding_window is not None
+    if cfg.layer_types:
+        sliding = [t == "sliding_attention" for t in cfg.layer_types]
+    else:
+        sliding = [
+            not cfg.alt_sliding_window or i % 2 == 0
+            for i in range(cfg.num_layers)
+        ]
+    win_arr = jnp.asarray(
+        [
+            cfg.sliding_window if (have_window and sliding[i]) else 1 << 30
+            for i in range(cfg.num_layers)
+        ],
+        jnp.int32,
+    )
+    if cfg.rope_local_base_freq is not None:
+        invf_local = rope_frequencies(hd, cfg.rope_local_base_freq)
+        invf_arr = jnp.stack(
+            [invf_local if s else inv_freq for s in sliding]
+        )
+    else:
+        invf_arr = jnp.tile(inv_freq[None], (cfg.num_layers, 1))
+
+    def layer(x, layer_in):
+        lp, k_pool, v_pool, win_l, invf_l = layer_in
+
+        def attend(q, k, v):
+            kp, vp = write_kv_pages(
+                k_pool,
+                v_pool,
+                k.reshape(N, cfg.num_kv_heads * hd),
+                v.reshape(N, cfg.num_kv_heads * hd),
+                page_ids,
+                offsets,
+                valid,
+            )
+            if use_pallas:
+                attn = _pallas_ragged(
+                    q[0], kp, vp, attn_table, row_of, positions,
+                    cfg.num_kv_heads, q_tile, mesh, interpret,
+                )[None]
+                return attn, (kp, vp)
+            from ..ops.ragged_attention import ragged_paged_attention_ref
+
+            attn = ragged_paged_attention_ref(
+                q[0], kp, vp, attn_table, row_of, positions,
+                num_kv_heads=cfg.num_kv_heads, sm_scale=sm_scale,
+                window=win_l if have_window else None,
+                softcap=cfg.attn_logit_softcap,
+            )[None]
+            return attn, (kp, vp)
+
+        return _attn_mlp_layer(
+            x, lp, cfg, invf_l, rope_pos, eps, attend, mesh=mesh
+        )
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], k_cache, v_cache, win_arr, invf_arr)
+    )
+    xo = x[0][out_idx]  # [M, D] — only sampled positions reach lm_head
+    return _final_logits(params, cfg, xo, eps), new_k, new_v
 
 
 def forward_ring_prefill(

@@ -1,11 +1,17 @@
 from .attention import dense_causal_attention, paged_attention, write_kv_pages
-from .paged_decode import paged_decode_attention
+from .ragged_attention import (
+    ragged_decode_attention,
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
+)
 from .rope import apply_rope, rope_frequencies
 from .sampling import apply_penalties, sample_tokens, token_logprobs
 
 __all__ = [
     "paged_attention",
-    "paged_decode_attention",
+    "ragged_paged_attention",
+    "ragged_paged_attention_ref",
+    "ragged_decode_attention",
     "dense_causal_attention",
     "write_kv_pages",
     "apply_rope",

@@ -16,9 +16,9 @@ reference for free — see SURVEY.md §2.9):
 This module is the always-correct XLA path and the CPU-mesh test oracle.
 The gather is bounded by the caller (``forward(attn_pages=...)`` slices
 the page table to the live context), and the QK/PV matmuls run in the
-cache dtype (bfloat16) with float32 accumulation on the MXU. The decode
-fast path is the ragged Pallas kernel in ``ops/paged_decode.py``, which
-this path cross-checks in tests.
+cache dtype (bfloat16) with float32 accumulation on the MXU. The fast
+path for prefill AND decode is the ragged Pallas kernel in
+``ops/ragged_attention.py``, which this path cross-checks in tests.
 """
 
 from __future__ import annotations

@@ -15,9 +15,12 @@ actually produced:
   ``p50_ttft_s`` over the metric's ISL gives prefill per token. Lines
   without a concurrency-tagged throughput metric fall back to their
   per-kind ``dispatch`` percentiles (every bench line carries them):
-  decode (in-flight + host-gap) p50 over ``decode_window`` tokens.
-  Decode spans carrying dispatch-profiler attrs contribute the same
-  per-window samples directly.
+  the ``ragged`` kind's (in-flight + host-gap) p50 over
+  ``decode_window`` tokens — pre-ragged bench files carry the old
+  ``decode`` kind, which is read as a fallback, so existing
+  ``BENCH_r*.json`` records stay fittable. Decode spans carrying
+  dispatch-profiler attrs contribute the same per-window samples
+  directly.
 
 Latencies are modeled lognormal (service times are multiplicative:
 right-skewed, never negative) around the fitted median; draws come from
@@ -297,8 +300,13 @@ def _bench_samples(
                 # to every line): (in-flight + host-gap) p50 over the
                 # line's decode_window is a per-token ITL sample — the
                 # fallback that fits service times from lines with no
-                # concurrency-tagged throughput metric.
-                disp = (rec.get("dispatch") or {}).get("decode") or {}
+                # concurrency-tagged throughput metric. The ragged
+                # engine emits kind="ragged"; pre-ragged BENCH_r*.json
+                # lines carry the old "decode" kind and stay fittable.
+                dispatch = rec.get("dispatch") or {}
+                disp = (
+                    dispatch.get("ragged") or dispatch.get("decode") or {}
+                )
                 flight = disp.get("in_flight_p50_s")
                 win = rec.get("decode_window")
                 if (

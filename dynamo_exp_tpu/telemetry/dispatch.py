@@ -1,16 +1,17 @@
 """Per-dispatch device profiling (docs/observability.md "Per-dispatch
 device profiling").
 
-The engine loop issues five kinds of device dispatch — prefill chunks,
-decode windows, speculative verify passes, KV page gather/scatter
-moves, and eviction offload batches — and in the overlapped steady
-state (docs/engine_perf.md) a throughput problem is always one of two
-things: the device spent too long *in flight*, or the host left a *gap*
-between consuming one dispatch and issuing the next. This profiler
-attributes wall time to exactly those two buckets per dispatch kind,
-plus compiled-variant cache behavior, using nothing but
-``time.monotonic()`` timestamps taken at call sites the engine already
-owns:
+The engine loop issues three kinds of device dispatch — ragged compute
+batches (pure-decode windows and mixed prefill+decode+spec batches
+alike, docs/engine_perf.md "One ragged dispatch"), KV page
+gather/scatter moves, and eviction offload batches — and in the
+overlapped steady state (docs/engine_perf.md) a throughput problem is
+always one of two things: the device spent too long *in flight*, or
+the host left a *gap* between consuming one dispatch and issuing the
+next. This profiler attributes wall time to exactly those two buckets
+per dispatch kind, plus compiled-variant cache behavior, using nothing
+but ``time.monotonic()`` timestamps taken at call sites the engine
+already owns:
 
 - ``begin(kind)`` immediately before the dispatch call records the
   **host gap** since the kind's previous consume (or previous dispatch,
@@ -44,10 +45,13 @@ from collections import deque
 
 from .slo import percentile
 
-# The engine's five device-dispatch kinds. Stable, closed set: the
+# The engine's three device-dispatch kinds. Stable, closed set: the
 # prometheus label space, the metrics() mirror, and bench.py's per-kind
-# percentiles all key on these names.
-DISPATCH_KINDS = ("prefill", "decode", "spec_verify", "kv_move", "offload")
+# percentiles all key on these names. ``ragged`` covers every compute
+# dispatch (the pre-ragged engine split it into prefill / decode /
+# spec_verify; sim/fit.py still reads those names from old span and
+# bench files for back-compat).
+DISPATCH_KINDS = ("ragged", "kv_move", "offload")
 
 # Summary stat fields (also the bench JSON / docs contract).
 SUMMARY_FIELDS = (

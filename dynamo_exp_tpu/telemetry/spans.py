@@ -311,7 +311,7 @@ class Telemetry:
             "dynamo_dispatch_seconds",
             "Device dispatch in-flight time (dispatch to the existing "
             "host sync), by dispatch kind",
-            ["kind"],  # prefill | decode | spec_verify | kv_move | offload
+            ["kind"],  # ragged | kv_move | offload (see telemetry/dispatch.py)
             buckets=_DISPATCH_BUCKETS,
             registry=self.registry,
         )

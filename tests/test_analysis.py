@@ -879,18 +879,16 @@ def test_full_tree_zero_unwaived_findings():
 
 
 def test_documented_engine_sync_points_are_the_allowlist():
-    """Satellite guard: the documented engine sync points (decode /
-    prefill / spec-verify consumes, the extract gather, the CopyStream
-    transfer) are exactly the kind of entries the host-sync allowlist
-    holds — and they all carry reasons."""
+    """Satellite guard: the documented engine sync points (the ragged
+    dispatch consumes, the extract gather, the CopyStream transfer)
+    are exactly the kind of entries the host-sync allowlist holds —
+    and they all carry reasons."""
     findings = [
         f for f in lint_tree(REPO, rules=["host-sync"]) if f.waived
     ]
     reasons = {f.reason for f in findings}
     assert {
-        "decode window consume",
-        "prefill consume",
-        "spec verify consume",
+        "ragged consume",
         "extract gather consume",
         "offload copy-thread transfer",
     } <= reasons, reasons

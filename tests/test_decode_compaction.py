@@ -67,10 +67,10 @@ def test_occupancy_one_compiles_rows_one_variant():
         prompt = [5, 9, 17, 3, 11]
         tokens, _ = asyncio.run(collect(eng, prompt, 8))
         assert tokens == greedy_oracle(prompt, 8)
-        rows_used = {key[0] for key in eng._decode_fns}
+        rows_used = {key[0] for key in eng._ragged_fns if key[2]}
         assert rows_used == {1}
         m = eng.metrics()
-        assert m["compiled_decode_variants"] == len(eng._decode_fns)
+        assert m["compiled_ragged_variants"] == len(eng._ragged_fns)
 
         # Saturating the slots compiles (and uses) a wider bucket.
         async def many():
@@ -79,7 +79,7 @@ def test_occupancy_one_compiles_rows_one_variant():
             )
 
         asyncio.run(many())
-        assert max(key[0] for key in eng._decode_fns) > 1
+        assert max(key[0] for key in eng._ragged_fns if key[2]) > 1
     finally:
         eng.stop()
 
@@ -107,7 +107,7 @@ def test_greedy_partition_unpolluted_by_sampler_row():
         for prompt, (tokens, _) in zip(prompts, results[:3]):
             assert tokens == greedy_oracle(prompt, 10)
         # Both partitions compiled: greedy variants + a sampler variant.
-        samplers = {key[3] for key in eng._decode_fns}
+        samplers = {key[3] for key in eng._ragged_fns}
         assert samplers == {False, True}
     finally:
         eng.stop()
@@ -258,8 +258,12 @@ def test_late_arrival_joins_chained_decode():
                 )
                 for _ in range(2)
             ]
-            # Let the long pair establish a steady chained cadence.
-            await asyncio.sleep(1.0)
+            # Let the long pair establish a steady chained cadence:
+            # wait until windows are demonstrably stepping (a fixed
+            # sleep is load-sensitive under a busy suite).
+            steps0 = eng.steps
+            while eng.steps < steps0 + 2 * eng.cfg.decode_window:
+                await asyncio.sleep(0.01)
             order: list[str] = []
 
             async def tagged(tag, coro):
@@ -406,19 +410,17 @@ def test_recompile_guard_steady_state():
             asyncio.run(run_mix(0, n))
         asyncio.run(run_mix(2, 2))
         for _ in range(5):
-            before = (len(eng._decode_fns), len(eng._prefill_fns))
+            before = len(eng._ragged_fns)
             asyncio.run(run_mix(4, 0))
             asyncio.run(run_mix(0, 4))
             asyncio.run(run_mix(2, 2))
-            if (len(eng._decode_fns), len(eng._prefill_fns)) == before:
+            if len(eng._ragged_fns) == before:
                 break
-        decode_variants = len(eng._decode_fns)
-        prefill_variants = len(eng._prefill_fns)
+        variants = len(eng._ragged_fns)
 
         for _ in range(3):
             asyncio.run(run_mix(2, 2))
-        assert len(eng._decode_fns) == decode_variants
-        assert len(eng._prefill_fns) == prefill_variants
+        assert len(eng._ragged_fns) == variants
     finally:
         eng.stop()
 
@@ -474,7 +476,7 @@ def test_single_sequence_decode_faster_than_full_batch():
         )
 
     def timed(rows, k, v, reps=5):
-        fn = eng._decode_fn(rows, pages, False, False)
+        fn = eng._ragged_fn(rows, pages, True, False, False)
         args = window_args(rows)
         times = []
         for _ in range(reps + 1):  # first call compiles; drop it
@@ -487,7 +489,7 @@ def test_single_sequence_decode_faster_than_full_batch():
     # Backend-independent proportionality: the compiled rows=1 program
     # does a fraction of the fixed-B program's FLOPs.
     def flops(rows):
-        fn = eng._decode_fn(rows, pages, False, False)
+        fn = eng._ragged_fn(rows, pages, True, False, False)
         ca = fn.lower(eng.params, k, v, *window_args(rows)).compile().cost_analysis()
         ca = ca[0] if isinstance(ca, list) else ca
         return float(ca["flops"])

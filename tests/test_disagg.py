@@ -296,8 +296,9 @@ async def test_disagg_e2e_matches_local():
         assert got == want
         assert disagg.remote_prefills == 1
         assert worker.served == 1
-        # Decode engine never ran a prefill bucket (pure injection).
-        assert not decode_eng._prefill_fns
+        # Decode engine never ran a prefill-shaped ragged dispatch
+        # (pure injection): every compiled variant is windowed decode.
+        assert all(key[2] for key in decode_eng._ragged_fns)
     finally:
         cancel.cancel()
         await asyncio.wait_for(worker_task, 5)

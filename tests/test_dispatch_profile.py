@@ -1,8 +1,9 @@
 """Per-dispatch device profiling (docs/observability.md).
 
-Covers the profiler acceptance path: every one of the five dispatch
-kinds — prefill chunk, decode window, spec verify, KV gather/scatter,
-eviction offload batch — gets host-gap/in-flight/compile attribution
+Covers the profiler acceptance path: every one of the three dispatch
+kinds — ragged compute batches (prefill chunks, decode windows, and
+spec verify spans all ride ``kind="ragged"``), KV gather/scatter, and
+eviction offload batches — gets host-gap/in-flight/compile attribution
 during one mixed run and surfaces on ``/metrics``; the decode span
 carries dispatch attrs ``sim/fit.py`` can fit from; and the overhead
 guarantee holds: profiling adds ZERO host syncs to the decode path
@@ -66,14 +67,14 @@ def test_profiler_summary_shape_is_stable():
 
 def test_profiler_gap_in_flight_and_compile_accounting():
     prof = DispatchProfiler()
-    t0 = prof.begin("decode")
-    t_disp = prof.end("decode", t0, fresh=True)
-    prof.consume("decode", t_disp)
+    t0 = prof.begin("ragged")
+    t_disp = prof.end("ragged", t0, fresh=True)
+    prof.consume("ragged", t_disp)
     # Second dispatch: the gap since the consume is now measurable.
-    t1 = prof.begin("decode")
-    t_disp = prof.end("decode", t1, fresh=False)
-    prof.consume("decode", t_disp)
-    s = prof.summary()["decode"]
+    t1 = prof.begin("ragged")
+    t_disp = prof.end("ragged", t1, fresh=False)
+    prof.consume("ragged", t_disp)
+    s = prof.summary()["ragged"]
     assert s["count"] == 2
     assert s["compile_misses"] == 1 and s["compile_total_s"] >= 0.0
     assert s["in_flight_p50_s"] is not None
@@ -82,11 +83,11 @@ def test_profiler_gap_in_flight_and_compile_accounting():
 
 def test_profiler_idle_drops_gap_reference():
     prof = DispatchProfiler()
-    t0 = prof.begin("decode")
-    prof.consume("decode", prof.end("decode", t0))
+    t0 = prof.begin("ragged")
+    prof.consume("ragged", prof.end("ragged", t0))
     prof.mark_idle()
-    prof.begin("decode")  # would be a huge gap if the mark survived idle
-    assert prof.summary()["decode"]["host_gap_p50_s"] is None
+    prof.begin("ragged")  # would be a huge gap if the mark survived idle
+    assert prof.summary()["ragged"]["host_gap_p50_s"] is None
 
 
 def test_first_variant_is_once_per_key():
@@ -99,10 +100,10 @@ def test_first_variant_is_once_per_key():
 
 # --------------------------------------------- all five kinds, one engine
 @pytest.mark.nightly
-async def test_all_five_dispatch_kinds_profiled_in_mixed_run():
+async def test_all_dispatch_kinds_profiled_in_mixed_run():
     """Acceptance: a mixed prefill+decode+spec run (plus the disagg
     extract and an eviction burst the same engine serves) populates
-    dispatch/host-gap timing for ALL five kinds, and the per-kind
+    dispatch/host-gap timing for ALL three kinds, and the per-kind
     series surface on the telemetry registry ``/metrics`` renders."""
     cfg = _cfg(
         num_pages=8,  # tight pool: the second prompt evicts the first's
@@ -116,7 +117,8 @@ async def test_all_five_dispatch_kinds_profiled_in_mixed_run():
     try:
         # Prefill + decode, pages registered then parked at finish.
         await _generate(engine, range(20, 36), max_tokens=6)
-        # Repetitive prompt: the n-gram drafter proposes (spec_verify),
+        # Repetitive prompt: the n-gram drafter proposes (spec spans
+        # in the mixed ragged dispatch),
         # and its 6-page allocation evicts parked pages (offload).
         block = [50, 51, 52, 53, 54, 55, 56, 57]
         await _generate(engine, block * 6, max_tokens=8)
@@ -135,15 +137,14 @@ async def test_all_five_dispatch_kinds_profiled_in_mixed_run():
             assert disp[kind]["count"] > 0, f"{kind} never dispatched"
         # Synced kinds carry in-flight samples (scatter-only moves
         # would not, but extract's gather is synced).
-        for kind in ("prefill", "decode", "spec_verify", "kv_move", "offload"):
+        for kind in ("ragged", "kv_move", "offload"):
             assert disp[kind]["in_flight_p50_s"] is not None, kind
-        # Compile attribution: every engine-keyed variant family missed
-        # at least once this run. The page-move gather shapes are ONE
-        # jit shared by kv_move and offload, so the miss lands on
-        # whichever kind dispatched the bucket first — assert across
-        # the pair, not per kind.
-        for kind in ("prefill", "decode", "spec_verify"):
-            assert disp[kind]["compile_misses"] >= 1, kind
+        # Compile attribution: the ragged variant cache missed at least
+        # once this run. The page-move gather shapes are ONE jit shared
+        # by kv_move and offload, so the miss lands on whichever kind
+        # dispatched the bucket first — assert across the pair, not per
+        # kind.
+        assert disp["ragged"]["compile_misses"] >= 1
         assert (
             disp["kv_move"]["compile_misses"]
             + disp["offload"]["compile_misses"]
@@ -199,27 +200,37 @@ async def test_decode_span_carries_dispatch_attrs_and_fit_reads_them(tmp_path):
 
 def test_bench_dispatch_stats_fit_without_throughput_metric(tmp_path):
     """A bench line with no concurrency-tagged metric still fits ITL
-    from its per-kind dispatch percentiles + decode_window."""
+    from its per-kind dispatch percentiles + decode_window — from the
+    ragged engine's ``kind="ragged"`` lines AND (back-compat) from
+    pre-ragged ``BENCH_r*.json`` lines that carry the old ``decode``
+    kind."""
     import json
 
     from dynamo_exp_tpu.sim.fit import ServiceTimeModel
 
-    line = {
-        "metric": "custom_point",
-        "value": 1.0,
-        "decode_window": 8,
-        "dispatch": {
-            "decode": {
-                "count": 10,
-                "in_flight_p50_s": 0.08,
-                "host_gap_p50_s": 0.008,
-            }
-        },
-    }
-    path = tmp_path / "bench.json"
-    path.write_text(json.dumps(line) + "\n")
-    model = ServiceTimeModel.from_bench_json([path])
+    def line(kind, flight):
+        return {
+            "metric": "custom_point",
+            "value": 1.0,
+            "decode_window": 8,
+            "dispatch": {
+                kind: {
+                    "count": 10,
+                    "in_flight_p50_s": flight,
+                    "host_gap_p50_s": 0.008,
+                }
+            },
+        }
+
+    old = tmp_path / "bench_old.json"
+    old.write_text(json.dumps(line("decode", 0.08)) + "\n")
+    model = ServiceTimeModel.from_bench_json([old])
     assert model.itl_s.median_s == pytest.approx((0.08 + 0.008) / 8)
+
+    new = tmp_path / "bench_ragged.json"
+    new.write_text(json.dumps(line("ragged", 0.16)) + "\n")
+    model = ServiceTimeModel.from_bench_json([new])
+    assert model.itl_s.median_s == pytest.approx((0.16 + 0.008) / 8)
 
 
 # ------------------------------------------------------- overhead (sync spy)
@@ -275,7 +286,7 @@ async def test_compile_misses_stop_in_steady_state():
             k: v["compile_misses"]
             for k, v in engine.metrics()["dispatch"].items()
         }
-        assert first["decode"] >= 1 and first["prefill"] >= 1
+        assert first["ragged"] >= 2  # prefill-shaped + windowed variants
         # Same shapes again: every variant is cached, misses must not move.
         await _generate(engine, range(60, 76), max_tokens=8)
         second = {

@@ -181,11 +181,11 @@ def test_dyn_spec_env_toggle(monkeypatch):
     assert EngineConfig(model=TINY).spec_mode == "off"
 
 
-def test_spec_draft_bucket_policy():
+def test_spec_draft_bounds_validated():
+    # Draft spans ride the mixed ragged token bucket (floor 16) — no
+    # dedicated draft bucket family anymore (docs/engine_perf.md).
     cfg = EngineConfig(model=TINY, spec_max_draft=8)
-    assert [cfg.spec_draft_bucket_for(n) for n in (1, 2, 3, 4, 5, 8)] == [
-        2, 2, 4, 4, 8, 8,
-    ]
+    assert cfg.ragged_tokens_bucket_for(cfg.spec_max_draft + 1, mixed=True) == 16
     with pytest.raises(ValueError, match="spec draft bounds"):
         EngineConfig(model=TINY, spec_min_draft=4, spec_max_draft=2)
 
@@ -362,10 +362,12 @@ async def test_spec_telemetry_counters_exposed(spec_engine):
         "spec_draft_tokens",
         "spec_accepted_tokens",
         "spec_emitted_tokens",
-        "compiled_spec_variants",
+        "compiled_ragged_variants",
     ):
         assert key in m
-    assert m["compiled_spec_variants"] == len(spec_engine._spec_fns) > 0
+    # Verify passes ride the ONE ragged variant cache (no dedicated
+    # spec-fn family anymore, docs/engine_perf.md).
+    assert m["compiled_ragged_variants"] == len(spec_engine._ragged_fns) > 0
     rendered = get_telemetry().render().decode()
     assert "dynamo_spec_draft_tokens_total" in rendered
     assert "dynamo_spec_accepted_tokens_total" in rendered
