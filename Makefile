@@ -23,7 +23,7 @@ SIM_SEED_SETS := 7,21,1337 3,9,27
 # must stay token-identical with spec on (docs/speculative.md).
 SPEC_SEED_SETS := 7,21,1337
 
-.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint
+.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke
 
 test:
 	$(PYTEST) tests/ -q -m "not tpu and not weekly"
@@ -79,6 +79,16 @@ flight:
 # — CPU timing is load-sensitive).
 profile-smoke:
 	$(PYTEST) tests/test_dispatch_profile.py -q -k overhead
+
+# AOT warm-boot smoke (docs/aot.md): boot an engine twice against a
+# tmp persistent compile-cache dir; the second boot must compile
+# NOTHING — zero ragged compile misses, zero variant growth under
+# traffic, zero new cache entries. Runs pre-merge (pre-merge.yml).
+prewarm-smoke:
+	env JAX_PLATFORMS=cpu python -m dynamo_exp_tpu.llmctl aot smoke \
+		--preset tiny --max-decode-slots 2 --page-size 8 \
+		--max-model-len 128 --prefill-chunk 16 --decode-window 4 \
+		--kv-dtype float32
 
 # Style lint (ruff) + dynlint, the AST invariant checkers
 # (docs/static_analysis.md): host-sync / determinism / thread-ownership

@@ -66,6 +66,9 @@ CPU_PREFIX_SWEEP_KW = dict(
 # tiny shapes, one draft length besides the off baseline.
 CPU_SPEC_KW = dict(slots=2, isl=96, osl=32, draft_lens=(0, 4))
 
+# Coldstart sweep CPU fallback: small shapes, the same trim policy.
+CPU_COLDSTART_KW = dict(isl=64, osl=16, concurrency=2)
+
 # Burst policy: warmup rounds (compile + program load) and timed rounds
 # (best-of). The CPU fallback trims both to 1 — XLA:CPU timings are
 # low-variance and a 1B-model burst is minutes, not seconds, there.
@@ -75,6 +78,12 @@ TIMED_BURSTS = 3
 # (XLA:CPU software-emulates bfloat16 matmuls — order-of-magnitude
 # slower than native f32 on the same cores).
 CPU_FALLBACK = False
+# AOT warm boot (docs/aot.md): --prewarm prewarns every bench engine
+# before measurement. LINE_TAGS rides on every JSON line so sim/fit.py
+# can tell warm samples from cold (the manifest hash pins which compile
+# lattice produced the numbers).
+PREWARM = False
+LINE_TAGS = {"prewarmed": False, "manifest_hash": None}
 
 
 def _preset(name: str):
@@ -123,23 +132,39 @@ def _dispatch_stats(engine) -> dict:
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compilation cache: repeat bench runs (and the
-    driver's end-of-round run) skip the 20-40s per-variant compiles, so
-    the measured TTFT reflects serving, not compilation."""
-    import jax
+    """Persistent XLA compilation cache (docs/aot.md): repeat bench
+    runs (and the driver's end-of-round run) skip the 20-40s
+    per-variant compiles, so the measured TTFT reflects serving, not
+    compilation. ``DYN_COMPILE_CACHE`` overrides the default path."""
+    from dynamo_exp_tpu.aot import cache_dir_from_env, enable_persistent_cache
 
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir", "/tmp/dynamo_tpu_jax_cache"
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # unknown option on this jax version — run uncached
-        pass
+    enable_persistent_cache(
+        cache_dir_from_env() or "/tmp/dynamo_tpu_jax_cache"
+    )
+
+
+def _build_engine(cfg, params=None, seed: int = 0):
+    """Every bench engine goes through here: tags each subsequent JSON
+    line with the engine's compile-manifest hash and whether it was
+    warm-booted (``--prewarm``), so ``sim/fit.py`` can split warm from
+    cold samples (docs/aot.md)."""
+    from dynamo_exp_tpu.aot import manifest_for_engine
+    from dynamo_exp_tpu.engine import TPUEngine
+
+    engine = TPUEngine(cfg, params=params, seed=seed)
+    manifest = manifest_for_engine(engine)
+    if PREWARM:
+        engine.prewarm(manifest)
+    LINE_TAGS.update(
+        prewarmed=bool(PREWARM), manifest_hash=manifest.hash()
+    )
+    engine.start()
+    return engine
 
 
 def run_point(isl: int, osl: int, concurrency: int) -> dict:
     """One measured point: build an engine, double-warm, time a burst."""
-    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.engine import EngineConfig
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
@@ -157,8 +182,7 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
         # sync-bound long before they are FLOP-bound on a tunneled chip.
         decode_window=32,
     )
-    engine = TPUEngine(cfg, seed=0)
-    engine.start()
+    engine = _build_engine(cfg)
 
     rs = np.random.RandomState(0)
 
@@ -239,7 +263,7 @@ def run_occupancy_sweep(
     cost snaps back to the worst case."""
     import asyncio
 
-    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.engine import EngineConfig
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
@@ -254,8 +278,7 @@ def run_occupancy_sweep(
         kv_dtype=_kv_dtype(),
         decode_window=32,
     )
-    engine = TPUEngine(cfg, seed=0)
-    engine.start()
+    engine = _build_engine(cfg)
     rs = np.random.RandomState(0)
 
     async def run_one(prompt):
@@ -386,8 +409,6 @@ def run_occupancy_sweep(
         ttfts = sorted(t for _, t in results[active:] if t is not None)
         return total / dt, ttfts, dt
 
-    
-
     for active in sorted({1, max(slots // 2, 1)}):
         tok_s, ttfts, _dt = asyncio.run(mixed_point(active))
         m = engine.metrics()
@@ -443,7 +464,7 @@ def run_overload_sweep(
     absorb the excess."""
     import asyncio
 
-    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.engine import EngineConfig
     from dynamo_exp_tpu.http.admission import (
         AdmissionController,
         RequestShedError,
@@ -465,8 +486,7 @@ def run_overload_sweep(
         decode_window=32,
         preempt_stall_grace_s=0.2,
     )
-    engine = TPUEngine(cfg, seed=0)
-    engine.start()
+    engine = _build_engine(cfg)
     rs = np.random.RandomState(0)
     priorities = ("low", "normal", "high")
 
@@ -568,7 +588,7 @@ def run_spec_sweep(
     tokens-per-dispatch from these lines."""
     import asyncio
 
-    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.engine import EngineConfig
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
@@ -599,8 +619,7 @@ def run_spec_sweep(
         actually continues (an arbitrary random block tiled into a
         prompt is repetitive to the *drafter* but not to the target's
         greedy trajectory, so acceptance would measure luck)."""
-        eng = TPUEngine(engine_cfg(1, "off", 0), seed=0)
-        eng.start()
+        eng = _build_engine(engine_cfg(1, "off", 0))
 
         async def gen(prompt):
             b = BackendInput(token_ids=prompt)
@@ -636,8 +655,7 @@ def run_spec_sweep(
         workload_prompts = build_prompts(workload)
         for draft in draft_lens:
             cfg = engine_cfg(slots, "off" if draft == 0 else "ngram", draft)
-            engine = TPUEngine(cfg, seed=0)
-            engine.start()
+            engine = _build_engine(cfg)
 
             async def run_one(prompt):
                 b = BackendInput(token_ids=prompt)
@@ -718,7 +736,7 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
     """
     import asyncio
 
-    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.engine import EngineConfig
     from dynamo_exp_tpu.protocols.common import BackendInput
 
     _enable_compile_cache()
@@ -733,8 +751,7 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
         kv_dtype=_kv_dtype(),
         decode_window=8,
     )
-    engine = TPUEngine(cfg, seed=0)
-    engine.start()
+    engine = _build_engine(cfg)
     rs = np.random.RandomState(0)
     shared = rs.randint(10, mcfg.vocab_size - 10, size=(isl * 7) // 8).tolist()
     tail = isl - len(shared)
@@ -826,9 +843,7 @@ def run_prefix_sweep(
             decode_window=8,
             prefix_sharing=sharing,
         )
-        eng = TPUEngine(cfg, seed=0)
-        eng.start()
-        return eng
+        return _build_engine(cfg)
 
     async def run_one(engine, prompt):
         b = BackendInput(token_ids=prompt)
@@ -928,6 +943,200 @@ def run_prefix_sweep(
     shared_eng.stop()
     private_eng.stop()
     return out
+
+
+def run_coldstart_sweep(
+    isl: int = 512, osl: int = 32, concurrency: int = 4
+) -> list[dict]:
+    """Cold vs warm boot: what an autoscaled instance pays between
+    "worker add" and serving (docs/aot.md "Coldstart study").
+
+    Three phases against one persistent compilation cache directory:
+
+    1. **cold** — a fresh engine with an *empty* cache serves the probe
+       burst; every variant compiles inline on the serving path, so its
+       first-token and first-burst TTFTs carry the compile stalls (this
+       also populates the cache, like the first instance of a fleet).
+    2. **populate** — ``aot_compile`` fills the remainder of the
+       lattice offline (the ``llmctl aot compile`` deployment step;
+       untimed).
+    3. **warm** — a fresh engine prewarns from the populated cache
+       before accepting traffic, then serves the identical burst with
+       zero compile misses.
+
+    Each arm's line reports the components separately — ``boot_s``
+    (engine build; weights are shared across arms, checkpoint load is
+    arm-invariant), ``prewarm_s``, ``first_token_s`` (serving start →
+    first emitted token), ``first_burst_ttft_p50_s``, and
+    ``steady_ttft_p50_s`` — plus ``provision_s`` (= boot + prewarm +
+    first token), which is what ``sim/fit.py`` feeds
+    ``planner_hints()`` → ``SloTargets.provision_s``. The headline
+    ``value`` is ``provision_s``; the summary line carries the
+    cold/warm ratios. On XLA:CPU (fallback) compiles are cheap and the
+    ratios are modest; on the real chip a variant compile is 20-40s and
+    the cold arm's stalls dominate everything (the ``platform`` tag
+    keeps the two regimes apart)."""
+    import asyncio
+    import tempfile
+
+    import jax
+
+    from dynamo_exp_tpu.aot import (
+        aot_compile,
+        enable_persistent_cache,
+        manifest_for_engine,
+    )
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models.llama import init_params
+    from dynamo_exp_tpu.protocols.common import BackendInput, SamplingOptions
+
+    cache_dir = tempfile.mkdtemp(prefix="dynamo_coldstart_")
+    enable_persistent_cache(cache_dir)
+    mcfg = _preset(MODEL)
+
+    def cfg() -> EngineConfig:
+        return EngineConfig(
+            model=mcfg,
+            max_decode_slots=concurrency,
+            page_size=16,
+            num_pages=concurrency * ((isl + osl) // 16 + 2) + 64,
+            max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
+            eos_token_ids=[],
+            kv_dtype=_kv_dtype(),
+            decode_window=8,
+        )
+
+    # Checkpoint load is arm-invariant (and not what AOT optimizes):
+    # share one weight init so the arms differ only in compile work.
+    params = init_params(jax.random.PRNGKey(0), mcfg)
+    jax.block_until_ready(params)
+    rs = np.random.RandomState(0)
+
+    def prompts() -> list[list[int]]:
+        return [
+            rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
+            for _ in range(concurrency)
+        ]
+
+    async def burst(engine, batch) -> tuple[float | None, list[float]]:
+        """Serve one mixed burst (alternating greedy / seeded rows);
+        returns (wall time of the burst's FIRST emitted token, all
+        per-request TTFTs)."""
+        first: list[float] = []
+        ttfts: list[float] = []
+
+        async def one(i: int, prompt):
+            b = BackendInput(token_ids=prompt)
+            b.stop_conditions.max_tokens = osl
+            b.stop_conditions.ignore_eos = True
+            if i % 2:
+                b.sampling_options = SamplingOptions(
+                    seed=i, temperature=0.8
+                )
+            t0 = time.perf_counter()
+            stream = await engine.generate(b.to_dict())
+            async for item in stream:
+                if item.get("token_ids"):
+                    now = time.perf_counter()
+                    first.append(now)
+                    ttfts.append(now - t0)
+                    break
+            async for _ in stream:
+                pass
+
+        await asyncio.gather(*[one(i, p) for i, p in enumerate(batch)])
+        return (min(first) if first else None), sorted(ttfts)
+
+    def arm(prewarmed: bool) -> dict:
+        t0 = time.perf_counter()
+        engine = TPUEngine(cfg(), params=params, seed=0)
+        manifest = manifest_for_engine(engine)
+        boot_s = time.perf_counter() - t0
+        prewarm_s = 0.0
+        if prewarmed:
+            report = engine.prewarm(manifest)
+            prewarm_s = report.seconds
+        engine.start()
+        serving_at = time.perf_counter()
+        first_at, ttfts = asyncio.run(burst(engine, prompts()))
+        first_token_s = (
+            first_at - serving_at if first_at is not None else None
+        )
+        _, steady = asyncio.run(burst(engine, prompts()))
+        m = engine.metrics()
+        disp = m["dispatch"]["ragged"]
+        provision_s = boot_s + prewarm_s + (first_token_s or 0.0)
+        point = {
+            "metric": (
+                f"coldstart_{MODEL}_isl{isl}_osl{osl}_c{concurrency}_"
+                f"{'warm' if prewarmed else 'cold'}"
+            ),
+            # Headline: provisioned -> first token (the serving-path
+            # stall AOT removes). The full worker-add -> first-token
+            # delay (boot + prewarm + first token) rides as
+            # ``provision_s`` — the sample sim/fit.py feeds the
+            # planner, warm and cold distinguished by ``prewarmed``.
+            "value": round(first_token_s, 3)
+            if first_token_s is not None
+            else None,
+            "unit": "s provisioned-to-first-token",
+            "provision_s": round(provision_s, 3),
+            "boot_s": round(boot_s, 3),
+            "prewarm_s": round(prewarm_s, 3),
+            "first_token_s": round(first_token_s, 3)
+            if first_token_s is not None
+            else None,
+            "first_burst_ttft_p50_s": round(
+                ttfts[len(ttfts) // 2], 3
+            )
+            if ttfts
+            else None,
+            "steady_ttft_p50_s": round(steady[len(steady) // 2], 3)
+            if steady
+            else None,
+            "prewarmed": prewarmed,
+            "manifest_hash": manifest.hash(),
+            "prewarmed_variants": m["prewarmed_variants"],
+            "compiled_ragged_variants": m["compiled_ragged_variants"],
+            "ragged_compile_misses": disp["compile_misses"],
+            "ragged_compile_total_s": disp["compile_total_s"],
+            "decode_window": engine.cfg.decode_window,
+            "dispatch": _dispatch_stats(engine),
+        }
+        engine.stop()
+        return point
+
+    cold = arm(False)
+    # Deployment's offline populate step (llmctl aot compile): fill the
+    # lattice entries cold traffic never walked. Untimed; the engine
+    # (and its full KV pool) is dropped before the warm arm boots so
+    # the warm measurement doesn't run under doubled HBM residency.
+    populate = TPUEngine(cfg(), params=params, seed=0)
+    aot_compile(populate, cache_dir=cache_dir)
+    del populate
+    warm = arm(True)
+
+    def ratio(a, b):
+        return round(a / b, 2) if a and b else None
+
+    summary = {
+        "metric": f"coldstart_{MODEL}_isl{isl}_osl{osl}_c{concurrency}"
+        "_speedup",
+        "value": ratio(cold["first_token_s"], warm["first_token_s"]),
+        "unit": "x provisioned-to-first-token",
+        "first_burst_ttft_speedup": ratio(
+            cold["first_burst_ttft_p50_s"], warm["first_burst_ttft_p50_s"]
+        ),
+        "full_provision_speedup": ratio(
+            cold["provision_s"], warm["provision_s"]
+        ),
+        "cold_provision_s": cold["provision_s"],
+        "warm_provision_s": warm["provision_s"],
+        "prewarmed": True,
+        "manifest_hash": warm["manifest_hash"],
+        "compile_cache_dir": cache_dir,
+    }
+    return [cold, warm, summary]
 
 
 def _fall_back_to_cpu(reason: str) -> str:
@@ -1033,6 +1242,19 @@ def main() -> None:
         "a shared-prefix ratio axis, sharing vs private-copy baseline",
     )
     ap.add_argument(
+        "--coldstart-sweep",
+        action="store_true",
+        help="cold vs AOT-warm boot: provision-to-first-token, "
+        "first-burst TTFT and compile-stall attribution per arm "
+        "against one persistent compile cache (docs/aot.md)",
+    )
+    ap.add_argument(
+        "--prewarm",
+        action="store_true",
+        help="prewarm every bench engine from the compile lattice "
+        "before measuring (lines are tagged prewarmed=true)",
+    )
+    ap.add_argument(
         "--model",
         default=None,
         help=f"preset name (default {MODEL}; {CPU_MODEL} on CPU fallback)",
@@ -1058,10 +1280,24 @@ def main() -> None:
     if args.concurrency is None:
         args.concurrency = CPU_CONCURRENCY if platform == "cpu" else CONCURRENCY
 
+    if args.prewarm:
+        global PREWARM
+        PREWARM = True
+
     def emit(point: dict) -> None:
-        print(json.dumps(point | {"platform": platform}), flush=True)
+        # Every line carries the warm/cold tag + manifest hash (set by
+        # _build_engine; coldstart lines carry their own per-arm
+        # values, which win) so sim/fit.py can split provision samples.
+        print(
+            json.dumps(dict(LINE_TAGS) | point | {"platform": platform}),
+            flush=True,
+        )
 
     cpu = platform == "cpu"
+    if args.coldstart_sweep:
+        for point in run_coldstart_sweep(**(CPU_COLDSTART_KW if cpu else {})):
+            emit(point)
+        return
     if args.sweep:
         s_isl = CPU_SWEEP_ISL if cpu else SWEEP_ISL
         s_osl = CPU_SWEEP_OSL if cpu else SWEEP_OSL

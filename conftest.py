@@ -87,6 +87,12 @@ _NIGHTLY_TESTS = {
     "test_capacity_exceeding_requests_finish_instead_of_hanging",
     "test_preemption_disabled_by_negative_grace",
     "test_overload_burst_no_hangs_sheds_tagged_streams_identical",
+    # AOT warm-boot proofs (compile-heavy: two full-lattice prewarns /
+    # a subprocess jax import; the lattice/fit/sim units in the same
+    # file stay pre_merge, and `make prewarm-smoke` gates pre-merge).
+    "test_warm_boot_compiles_nothing",
+    "test_identity_prewarmed_vs_cold_all_sampler_modes",
+    "test_manifest_hash_identical_across_processes",
 }
 
 

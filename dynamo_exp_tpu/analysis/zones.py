@@ -49,6 +49,12 @@ DETERMINISM_ZONES: tuple[Zone, ...] = (
     Zone("dynamo_exp_tpu/spec/"),
     Zone("dynamo_exp_tpu/runtime/transports/chaos.py"),
     Zone("dynamo_exp_tpu/telemetry/flight.py", include=("FlightRecorder",)),
+    # The AOT compile lattice (docs/aot.md): the manifest hash IS the
+    # cache-invalidation key, so enumeration and hashing must be free
+    # of id()/wall-clock/uuid — byte-identical across processes and
+    # hosts. The prewarm/compile timing metrics are the only sanctioned
+    # wall-clock reads (inline-waived: "prewarm wall-clock metric").
+    Zone("dynamo_exp_tpu/aot/"),
 )
 
 # ------------------------------------------------- thread-ownership model

@@ -1,0 +1,198 @@
+"""AOT compilation of the lattice + persistent-cache wiring
+(docs/aot.md "Compiling the lattice offline").
+
+``aot_compile`` walks a :class:`~.lattice.CompileManifest` and runs the
+SNIPPETS-grounded ahead-of-time compile step for every entry:
+``jit_fn.lower(*args).compile()`` with the engine's explicit shardings
+(params / KV pools are the engine's real committed arrays — ``lower``
+reads their avals and shardings without executing or consuming donated
+buffers). With the JAX persistent compilation cache enabled, every
+compiled executable serializes to ``cache_dir`` keyed by its HLO hash —
+so a *different process* (a freshly provisioned instance) that builds
+the same programs deserializes them instead of recompiling, which is
+the entire warm-boot story.
+
+The lowering arguments come from :func:`~.warmup.variant_call_args` —
+the same tuples ``prewarm_engine`` executes with — so the compiler, the
+warmer, and the live dispatch sites cannot drift apart without the
+prewarm-smoke gate catching it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+from .lattice import CompileManifest, build_manifest
+
+log = logging.getLogger(__name__)
+
+# Environment override for the persistent-cache directory; the
+# ``--compile-cache-dir`` flags on run.py / llmctl aot / bench.py win.
+CACHE_ENV = "DYN_COMPILE_CACHE"
+MANIFEST_FILENAME = "manifest.json"
+
+
+def cache_dir_from_env() -> str:
+    return os.environ.get(CACHE_ENV, "").strip()
+
+
+def enable_persistent_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (created
+    if missing) and drop the min-compile-time / min-entry-size gates so
+    even small variants serialize. Returns False (and runs uncached)
+    when this jax build doesn't support the options."""
+    import jax
+
+    if not cache_dir:
+        return False
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # unknown option on this jax version
+        log.warning("persistent compilation cache unsupported; running uncached")
+        return False
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # older jax: keep its default gate
+            pass
+    # The cache object is memoized at first use: a process that already
+    # compiled anything (engine construction jits device_puts) latched a
+    # disabled cache and would silently ignore the new directory — reset
+    # so the updated config is actually read.
+    try:
+        from jax._src.compilation_cache import reset_cache
+
+        reset_cache()
+    except Exception:  # private seam moved: cache may already be live
+        pass
+    return True
+
+
+def manifest_for_engine(engine, **kwargs) -> CompileManifest:
+    """The full compile lattice for a live engine: its resolved
+    attention implementation, its mesh shape, this process's jax."""
+    import jax
+
+    return build_manifest(
+        engine.cfg,
+        attn_impl=engine._attn_impl,
+        mesh_shape=dict(engine.mesh.shape),
+        jax_version=jax.__version__,
+        interpret=engine._attn_interpret,
+        **kwargs,
+    )
+
+
+@dataclass
+class AotCompileReport:
+    manifest_hash: str = ""
+    compiled: int = 0
+    seconds: float = 0.0
+    cache_dir: str = ""
+    failed: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "manifest_hash": self.manifest_hash,
+            "compiled": self.compiled,
+            "seconds": round(self.seconds, 3),
+            "cache_dir": self.cache_dir,
+            "failed": list(self.failed),
+        }
+
+
+def aot_compile(
+    engine,
+    manifest: CompileManifest | None = None,
+    cache_dir: str = "",
+) -> AotCompileReport:
+    """AOT-lower and compile every manifest entry through the engine's
+    own program builders. Pure compilation: nothing executes, no
+    donated buffer is consumed, the engine's ``_ragged_fns`` cache ends
+    up populated with the (still-unexecuted) jit wrappers. With
+    ``cache_dir`` (or ``$DYN_COMPILE_CACHE``) set, every executable is
+    also serialized for other processes; the manifest JSON is dropped
+    next to the cache entries for ``llmctl aot list`` and hash checks."""
+    import jax.numpy as jnp
+
+    from .warmup import variant_call_args
+
+    cache_dir = cache_dir or cache_dir_from_env()
+    if cache_dir:
+        enable_persistent_cache(cache_dir)
+    if manifest is None:
+        manifest = manifest_for_engine(engine)
+    t0 = time.monotonic()  # dynlint: determinism(prewarm wall-clock metric)
+    report = AotCompileReport(
+        manifest_hash=manifest.hash(), cache_dir=cache_dir
+    )
+    for variant in manifest.ragged:
+        fn = engine._ragged_fn_from_key(variant.key)
+        try:
+            fn.lower(*variant_call_args(engine, variant.key)).compile()
+            report.compiled += 1
+        except Exception as e:  # noqa: BLE001 - record, keep compiling
+            log.exception("AOT compile failed for %s", variant)
+            report.failed.append(f"{variant.key}: {e}")
+    k, v = engine.k_cache, engine.v_cache
+    for bucket in manifest.move_buckets:
+        pids = jnp.zeros(bucket, jnp.int32)
+        try:
+            engine._gather_pages.lower(k, v, pids).compile()
+            # The scatter's page payloads have the gather's output
+            # shape: [L, bucket, page_size, HkvD] in the KV dtype.
+            L = engine.cfg.model.num_layers
+            hkv = (
+                engine.cfg.model.num_kv_heads * engine.cfg.model.head_dim_
+            )
+            page = jnp.zeros(
+                (L, bucket, engine.cfg.page_size, hkv),
+                engine.cfg.kv_dtype_jnp,
+            )
+            engine._inject_pages.lower(k, v, pids, page, page).compile()
+            report.compiled += 2
+        except Exception as e:  # noqa: BLE001
+            log.exception("AOT compile failed for move bucket %d", bucket)
+            report.failed.append(f"move:{bucket}: {e}")
+    try:
+        zero = jnp.asarray(0, jnp.int32)
+        engine._cow_pages.lower(k, v, zero, zero).compile()
+        engine._init_row.lower(
+            engine._counts, engine.cfg.max_decode_slots, 0
+        ).compile()
+        report.compiled += 2
+    except Exception as e:  # noqa: BLE001
+        log.exception("AOT compile failed for cow/init_row")
+        report.failed.append(f"cow/init_row: {e}")
+    report.seconds = time.monotonic() - t0  # dynlint: determinism(prewarm wall-clock metric)
+    if cache_dir:
+        write_manifest(cache_dir, manifest)
+    log.info(
+        "aot: compiled %d variants in %.2fs (manifest %s)%s",
+        report.compiled, report.seconds, report.manifest_hash[:12],
+        f", {len(report.failed)} FAILED" if report.failed else "",
+    )
+    return report
+
+
+def write_manifest(cache_dir: str, manifest: CompileManifest) -> str:
+    path = os.path.join(cache_dir, MANIFEST_FILENAME)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(manifest.to_json(indent=2))
+        f.write("\n")
+    return path
+
+
+def read_manifest(cache_dir: str) -> CompileManifest | None:
+    path = os.path.join(cache_dir, MANIFEST_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return CompileManifest.from_json(f.read())

@@ -1,5 +1,5 @@
 from .config import EngineConfig
-from .engine import TPUEngine
+from .engine import TPUEngine, resolve_attn_impl
 from .kv_manager import KvEvent, KvPageManager
 from .offload import CopyStream, HostKvPool
 from .scheduler import Scheduler, Sequence
@@ -7,6 +7,7 @@ from .scheduler import Scheduler, Sequence
 __all__ = [
     "EngineConfig",
     "TPUEngine",
+    "resolve_attn_impl",
     "KvPageManager",
     "KvEvent",
     "HostKvPool",

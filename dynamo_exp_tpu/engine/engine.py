@@ -56,6 +56,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..aot.lattice import impl_for_key, resolve_ragged_key
 from ..models.config import ModelConfig
 from ..models.llama import (
     Params,
@@ -92,6 +93,58 @@ from .offload import CopyStream, HostKvPool
 from .scheduler import RemoteKv, Scheduler, SeqState, Sequence
 
 log = logging.getLogger(__name__)
+
+
+def resolve_attn_impl(cfg: EngineConfig, mesh: Mesh) -> tuple[str, bool]:
+    """Pick the decode attention implementation. ``auto`` resolves to
+    the ragged Pallas kernel only when the mesh actually sits on TPU
+    (or ``pallas_interpret`` forces interpreter mode for CPU tests);
+    anywhere else the length-bounded XLA gather is the correct
+    choice. Layouts Mosaic can't tile (``ragged_supported``) fall
+    back to XLA rather than fail at compile time on the first
+    decode.
+
+    A free function (not a method) because the resolved impl is part of
+    the AOT compile-lattice key: ``llmctl aot list`` resolves it from
+    (config, mesh) alone, without paying a weight init."""
+    from ..ops.ragged_attention import ragged_supported
+
+    impl = cfg.attention_impl
+    interpret = cfg.pallas_interpret
+    if impl == "auto":
+        platform = mesh.devices.flat[0].platform
+        impl = "pallas" if (platform == "tpu" or interpret) else "xla"
+    mcfg = cfg.model
+    if impl == "pallas" and (
+        mcfg.sliding_window is not None
+        or mcfg.attn_logit_softcap is not None
+        or mcfg.query_pre_attn_scalar is not None
+    ):
+        # forward() would silently refuse the kernel for these
+        # configs (window mask / softcap / scale live on the XLA
+        # path); resolve xla HERE so attn_pages keeps bounding the
+        # gather — otherwise decode would run the XLA path with an
+        # unbounded Pmax-wide page table.
+        impl = "xla"
+    if impl == "pallas" and not interpret:
+        tp = mesh.shape.get("tp", 1)
+        if not ragged_supported(
+            cfg.page_size,
+            cfg.model.num_kv_heads // tp,
+            cfg.model.head_dim_,
+            cfg.kv_dtype_jnp,
+        ):
+            log.warning(
+                "KV layout (ps=%d, Hkv=%d/tp=%d, D=%d, %s) is not "
+                "Mosaic-tileable; decode falls back to the XLA path",
+                cfg.page_size,
+                cfg.model.num_kv_heads,
+                tp,
+                cfg.model.head_dim_,
+                cfg.kv_dtype,
+            )
+            impl = "xla"
+    return impl, interpret
 
 
 @dataclass
@@ -305,6 +358,10 @@ class TPUEngine(AsyncEngine):
         self._running = False
         self._thread: threading.Thread | None = None
         self.steps = 0  # decode step counter (metrics)
+        # Warm-boot provisioning (docs/aot.md): variants loaded/built by
+        # prewarm() and the boot time it took. 0/0.0 = cold boot.
+        self.prewarmed_variants = 0
+        self.prewarm_seconds = 0.0
         self._last_gauge_pub = 0.0  # telemetry gauge throttle
         self._last_reap = 0.0  # waiting-deque reap throttle
         # Watchdog progress: bumped once per loop iteration that did
@@ -352,52 +409,7 @@ class TPUEngine(AsyncEngine):
 
     # ----------------------------------------------------------- compiled fns
     def _resolve_attn(self) -> tuple[str, bool]:
-        """Pick the decode attention implementation. ``auto`` resolves to
-        the ragged Pallas kernel only when the mesh actually sits on TPU
-        (or ``pallas_interpret`` forces interpreter mode for CPU tests);
-        anywhere else the length-bounded XLA gather is the correct
-        choice. Layouts Mosaic can't tile (``ragged_supported``) fall
-        back to XLA rather than fail at compile time on the first
-        decode."""
-        from ..ops.ragged_attention import ragged_supported
-
-        cfg = self.cfg
-        impl = cfg.attention_impl
-        interpret = cfg.pallas_interpret
-        if impl == "auto":
-            platform = self.mesh.devices.flat[0].platform
-            impl = "pallas" if (platform == "tpu" or interpret) else "xla"
-        mcfg = cfg.model
-        if impl == "pallas" and (
-            mcfg.sliding_window is not None
-            or mcfg.attn_logit_softcap is not None
-            or mcfg.query_pre_attn_scalar is not None
-        ):
-            # forward() would silently refuse the kernel for these
-            # configs (window mask / softcap / scale live on the XLA
-            # path); resolve xla HERE so attn_pages keeps bounding the
-            # gather — otherwise decode would run the XLA path with an
-            # unbounded Pmax-wide page table.
-            impl = "xla"
-        if impl == "pallas" and not interpret:
-            tp = self.mesh.shape.get("tp", 1)
-            if not ragged_supported(
-                cfg.page_size,
-                cfg.model.num_kv_heads // tp,
-                cfg.model.head_dim_,
-                cfg.kv_dtype_jnp,
-            ):
-                log.warning(
-                    "KV layout (ps=%d, Hkv=%d/tp=%d, D=%d, %s) is not "
-                    "Mosaic-tileable; decode falls back to the XLA path",
-                    cfg.page_size,
-                    cfg.model.num_kv_heads,
-                    tp,
-                    cfg.model.head_dim_,
-                    cfg.kv_dtype,
-                )
-                impl = "xla"
-        return impl, interpret
+        return resolve_attn_impl(self.cfg, self.mesh)
 
     def _ragged_fn(
         self,
@@ -444,19 +456,25 @@ class TPUEngine(AsyncEngine):
         Even when the Pallas kernel is available, short contexts take
         the XLA gather: below ~1k tokens of page bucket the gather's
         HBM traffic is trivial and the kernel's serial per-row DMA grid
-        costs more than it saves."""
-        impl, interpret, mesh = self._attn_impl, self._attn_interpret, self.mesh
-        if (
-            impl == "pallas"
-            and self.cfg.attention_impl == "auto"  # explicit pallas is honored
-            and attn_pages * self.cfg.page_size <= 1024
-        ):
-            impl = "xla"
-        pages = None if impl == "pallas" else attn_pages
-        key = (nb, pages, windowed, full_sampler, want_lp, with_spec)
+        costs more than it saves. That rule (and the Pallas page-bound
+        collapse) lives in ``aot.lattice.resolve_ragged_key`` — ONE key
+        function shared with the offline lattice enumeration, so the
+        AOT manifest can never drift from what this loop dispatches."""
+        key = resolve_ragged_key(
+            self.cfg, self._attn_impl, nb, attn_pages, windowed,
+            full_sampler, want_lp, with_spec,
+        )
+        return self._ragged_fn_from_key(key)
+
+    def _ragged_fn_from_key(self, key: tuple):
+        """Build (or fetch) the compiled program for an already-resolved
+        variant key — the seam ``aot/`` prewarm and AOT compilation
+        drive directly from manifest entries."""
         fn = self._ragged_fns.get(key)
         if fn is not None:
             return fn
+        nb, pages, windowed, full_sampler, want_lp, with_spec = key
+        impl = impl_for_key(key)
         fn = (
             self._windowed_program(nb, pages, impl, full_sampler, want_lp)
             if windowed
@@ -790,6 +808,50 @@ class TPUEngine(AsyncEngine):
             self.copy_stream.drain()
             self.copy_stream.stop()
             self.copy_stream = None
+
+    def prewarm(self, manifest=None, cache_dir: str = ""):
+        """Warm-boot provisioning (docs/aot.md): compile/load every
+        compile-lattice variant BEFORE the engine accepts traffic, so
+        the first dispatch of every shape is steady-state fast and the
+        compile-miss counters stay flat from the very first request.
+
+        Runs strictly pre-loop (the same single-threaded window
+        ``__init__`` owns — a running engine is refused, like a second
+        ``start()``): prewarm executes each variant once as an
+        all-padding batch, threading the donated KV pools through, then
+        seeds the dispatch profiler's variant-freshness state so a
+        prewarmed kernel's first traffic dispatch is never mis-charged
+        as a cold compile. With ``cache_dir`` (or ``$DYN_COMPILE_CACHE``)
+        naming a populated persistent compilation cache, the compiles
+        are deserializations and a boot collapses to program-load time.
+
+        ``manifest`` defaults to this engine's own full lattice.
+        Returns the :class:`~dynamo_exp_tpu.aot.warmup.PrewarmReport`.
+        """
+        from ..aot.compile import cache_dir_from_env, enable_persistent_cache
+        from ..aot.warmup import prewarm_engine
+
+        if self._running:
+            raise RuntimeError(
+                "prewarm() must run before the engine accepts traffic"
+            )
+        cache_dir = cache_dir or cache_dir_from_env()
+        if cache_dir:
+            enable_persistent_cache(cache_dir)
+        report = prewarm_engine(self, manifest)
+        self.prewarmed_variants = report.variants
+        self.prewarm_seconds = report.seconds
+        tel = get_telemetry()
+        tel.prewarm_seconds.set(report.seconds)
+        tel.prewarm_variants.labels("ragged").inc(report.ragged_variants)
+        tel.prewarm_variants.labels("move").inc(report.move_variants)
+        if self.flight is not None:
+            self.flight.record(
+                "prewarm",
+                ragged=report.ragged_variants,
+                moves=report.move_variants,
+            )
+        return report
 
     # ------------------------------------------------------------ AsyncEngine
     async def generate(
@@ -2608,6 +2670,11 @@ class TPUEngine(AsyncEngine):
         # The ONE ragged variant cache (docs/engine_perf.md "One
         # ragged dispatch") replaces the old per-family mirrors.
         m["compiled_ragged_variants"] = len(self._ragged_fns)
+        # Warm-boot provisioning (docs/aot.md): variants prewarm()
+        # loaded before first traffic and what the boot cost — the
+        # prewarm-smoke gate and bench.py --coldstart-sweep read these.
+        m["prewarmed_variants"] = self.prewarmed_variants
+        m["prewarm_seconds"] = round(self.prewarm_seconds, 6)
         # Per-dispatch profiler mirror (docs/observability.md): per-kind
         # host-gap / in-flight percentiles over the recent window plus
         # compile attribution — the same numbers the dynamo_dispatch_*

@@ -233,9 +233,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sim.add_argument(
         "workload",
-        choices=("burst", "ramp", "users"),
+        choices=("burst", "ramp", "users", "diurnal"),
         help="burst: the overload_burst chaos scenario; ramp: linear "
-        "arrival-rate ramp; users: open-loop synthetic user stream",
+        "arrival-rate ramp; users: open-loop synthetic user stream; "
+        "diurnal: periodic burst swinging between --rps-start and "
+        "--rps-end (the coldstart/provisioning study, docs/aot.md)",
     )
     sim.add_argument("--seed", type=int, default=0)
     sim.add_argument("--requests", type=int, default=None,
@@ -285,6 +287,51 @@ def build_parser() -> argparse.ArgumentParser:
         help="private-copy baseline: prefix groups route by overlap "
         "but every request pays full pages",
     )
+    sim.add_argument(
+        "--period-s", type=float, default=300.0,
+        help="diurnal workload: burst period in seconds (rate swings "
+        "between --rps-start and --rps-end each period)",
+    )
+    sim.add_argument(
+        "--provision-s", type=float, default=None,
+        help="override the worker add -> serving delay (both the "
+        "modeled spawn time and the SLO planner's provision_s hint) — "
+        "the coldstart-study knob, docs/aot.md",
+    )
+
+    # Offline AOT precompilation (docs/aot.md): enumerate the compile
+    # lattice, AOT-compile it into the persistent compilation cache,
+    # and warm-boot engines from it.
+    aot = sub.add_parser(
+        "aot", help="AOT compile lattice: enumerate, precompile, warm-boot"
+    )
+    aot.add_argument(
+        "command", choices=("compile", "list", "warm", "smoke"),
+        help="compile: AOT-compile every manifest entry into the cache "
+        "dir; list: print the manifest (no compilation); warm: boot an "
+        "engine via prewarm and report; smoke: boot twice against a "
+        "tmp cache dir and fail on any second-boot compile miss",
+    )
+    aot.add_argument("--preset", default="tiny",
+                     help="built-in model preset (random weights)")
+    aot.add_argument("--compile-cache-dir", default="",
+                     help="persistent compilation cache directory "
+                     "(default: $DYN_COMPILE_CACHE; smoke uses a tmp dir)")
+    aot.add_argument("--tp", type=int, default=1)
+    aot.add_argument("--max-decode-slots", type=int, default=4)
+    aot.add_argument("--page-size", type=int, default=16)
+    aot.add_argument("--num-pages", type=int, default=0, help="0 = auto")
+    aot.add_argument("--max-model-len", type=int, default=512)
+    aot.add_argument("--decode-window", type=int, default=8)
+    aot.add_argument("--prefill-chunk", type=int, default=128)
+    aot.add_argument("--kv-dtype", default="bfloat16",
+                     choices=["bfloat16", "float32"])
+    aot.add_argument("--spec", default="off",
+                     help="speculative drafter (adds the draft-carrying "
+                     "variants to the lattice)")
+    aot.add_argument("--no-lp", action="store_true",
+                     help="drop the logprob variants (halves the lattice "
+                     "for deployments that never serve logprobs)")
     return p
 
 
@@ -350,6 +397,156 @@ def run_flight(args) -> int:
     return 0
 
 
+async def run_aot(args) -> int:
+    """The offline AOT plane (docs/aot.md): enumerate / precompile /
+    warm-boot the engine compile lattice. ``list`` is weight-free; the
+    other commands build a random-weight engine of the given shape."""
+    import os
+    import tempfile
+
+    from .aot import (
+        aot_compile,
+        build_manifest,
+        cache_dir_from_env,
+        enable_persistent_cache,
+        manifest_for_engine,
+    )
+    from .engine import EngineConfig, TPUEngine, resolve_attn_impl
+    from .models import PRESETS
+    from .parallel.mesh import build_mesh
+
+    mcfg = PRESETS[args.preset]
+    max_len = min(args.max_model_len, mcfg.max_position_embeddings)
+    cfg = EngineConfig(
+        model=mcfg,
+        max_decode_slots=args.max_decode_slots,
+        page_size=args.page_size,
+        num_pages=args.num_pages
+        or (args.max_decode_slots * (max_len // args.page_size + 1) + 64),
+        max_model_len=max_len,
+        tp=args.tp,
+        eos_token_ids=[],
+        kv_dtype=args.kv_dtype,
+        decode_window=args.decode_window,
+        prefill_chunk=args.prefill_chunk,
+        spec_mode=args.spec,
+    )
+    cache_dir = args.compile_cache_dir or cache_dir_from_env()
+    include_lp = not args.no_lp
+
+    if args.command == "list":
+        import jax
+
+        mesh = build_mesh(tp=cfg.tp, sp=cfg.sp)
+        impl, interpret = resolve_attn_impl(cfg, mesh)
+        manifest = build_manifest(
+            cfg, attn_impl=impl, mesh_shape=dict(mesh.shape),
+            jax_version=jax.__version__, interpret=interpret,
+            include_lp=include_lp,
+        )
+        print(manifest.to_json(indent=2))
+        print(
+            f"# {len(manifest.ragged)} ragged variants, "
+            f"{len(manifest.move_buckets)} move buckets, "
+            f"hash {manifest.hash()}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if cache_dir:
+        enable_persistent_cache(cache_dir)
+
+    async def traffic(engine, n: int = 2, prompt_len: int = 24) -> None:
+        """A tiny mixed probe burst (greedy + seeded rows)."""
+
+        async def one(i: int):
+            req = {
+                "token_ids": list(range(3 + i, 3 + i + prompt_len)),
+                "stop_conditions": {"max_tokens": 8, "ignore_eos": True},
+            }
+            if i % 2:
+                req["sampling_options"] = {"seed": i, "temperature": 0.8}
+            stream = await engine.generate(req)
+            async for _ in stream:
+                pass
+
+        await asyncio.gather(*[one(i) for i in range(n)])
+
+    if args.command == "compile":
+        engine = TPUEngine(cfg, seed=0)
+        manifest = manifest_for_engine(engine, include_lp=include_lp)
+        report = aot_compile(engine, manifest, cache_dir=cache_dir)
+        print(json.dumps(report.to_dict(), indent=2))
+        return 1 if report.failed else 0
+
+    if args.command == "warm":
+        engine = TPUEngine(cfg, seed=0)
+        manifest = manifest_for_engine(engine, include_lp=include_lp)
+        report = engine.prewarm(manifest, cache_dir=cache_dir)
+        await traffic(engine)
+        m = engine.metrics()
+        print(
+            json.dumps(
+                {
+                    "manifest_hash": report.manifest_hash,
+                    "prewarmed_variants": report.variants,
+                    "prewarm_seconds": round(report.seconds, 3),
+                    "compiled_ragged_variants": m["compiled_ragged_variants"],
+                    "ragged_compile_misses_after_warm": m["dispatch"][
+                        "ragged"
+                    ]["compile_misses"],
+                },
+                indent=2,
+            )
+        )
+        engine.stop()
+        return 0
+
+    # smoke: two warm boots against one cache dir; the second must
+    # compile nothing — no ragged misses, no variant growth, no new
+    # cache entries (the pre-merge `make prewarm-smoke` gate). Always a
+    # FRESH tmp dir (the help text's promise): running against a shared
+    # $DYN_COMPILE_CACHE would skip the population half of the test and
+    # write probe entries into a production cache.
+    cache_dir = tempfile.mkdtemp(prefix="dynamo_aot_smoke_")
+    enable_persistent_cache(cache_dir)
+
+    async def boot() -> tuple[dict, int]:
+        engine = TPUEngine(cfg, seed=0)
+        engine.prewarm(
+            manifest_for_engine(engine, include_lp=include_lp),
+            cache_dir=cache_dir,
+        )
+        await traffic(engine)
+        m = engine.metrics()
+        engine.stop()
+        return m, len(os.listdir(cache_dir))
+
+    m1, files1 = await boot()
+    m2, files2 = await boot()
+    misses = m2["dispatch"]["ragged"]["compile_misses"]
+    new_files = files2 - files1
+    verdict = {
+        "cache_dir": cache_dir,
+        "boot1_prewarm_s": m1["prewarm_seconds"],
+        "boot2_prewarm_s": m2["prewarm_seconds"],
+        "boot2_ragged_compile_misses": misses,
+        "boot2_new_cache_files": new_files,
+        "boot2_variant_growth_after_traffic": m2[
+            "compiled_ragged_variants"
+        ]
+        - m1["compiled_ragged_variants"],
+        "ok": misses == 0
+        and new_files == 0
+        and m2["compiled_ragged_variants"] == m1["compiled_ragged_variants"],
+    }
+    print(json.dumps(verdict, indent=2))
+    if not verdict["ok"]:
+        print("prewarm-smoke FAILED: second boot compiled", file=sys.stderr)
+        return 1
+    return 0
+
+
 def run_sim(args) -> int:
     from .planner import PlannerConfig, SloTargets
     from .sim import (
@@ -357,6 +554,7 @@ def run_sim(args) -> int:
         ServiceTimeModel,
         SimConfig,
         burst_workload,
+        diurnal_workload,
         load_trace,
         ramp_workload,
         save_trace,
@@ -373,6 +571,14 @@ def run_sim(args) -> int:
             duration_s=args.duration_s,
             rps_start=args.rps_start,
             rps_end=args.rps_end,
+        )
+    elif args.workload == "diurnal":
+        workload = diurnal_workload(
+            args.seed,
+            duration_s=args.duration_s,
+            rps_base=args.rps_start,
+            rps_peak=args.rps_end,
+            period_s=args.period_s,
         )
     else:
         workload = synthetic_users(
@@ -419,6 +625,7 @@ def run_sim(args) -> int:
         shed_watermark=args.shed_watermark,
         admission_per_instance=args.planner != "none",
         initial_instances=args.instances,
+        provision_s=args.provision_s,
         planner=None if args.planner == "none" else args.planner,
         planner_cfg=PlannerConfig(
             max_tpu_budget=args.max_tpu_budget, min_endpoint=1
@@ -427,8 +634,14 @@ def run_sim(args) -> int:
             ttft_p99_slo_s=args.ttft_slo_s,
             itl_p99_slo_s=args.itl_slo_s,
             # Fitted-service hint: scale for where the trend will be
-            # when a new worker actually lands.
-            provision_s=service.planner_hints()["provision_s"],
+            # when a new worker actually lands. A measured cold/warm
+            # provision (bench.py --coldstart-sweep via --fit-bench, or
+            # the --provision-s study knob) flows in here (docs/aot.md).
+            provision_s=(
+                args.provision_s
+                if args.provision_s is not None
+                else service.planner_hints()["provision_s"]
+            ),
         ),
         service=service,
         record_events=args.events,
@@ -497,6 +710,8 @@ async def run(args) -> int:
         return run_flight(args)
     if args.plane == "sim":  # offline: modeled fleet, no cluster
         return run_sim(args)
+    if args.plane == "aot":  # offline: compile lattice, no cluster
+        return await run_aot(args)
     if args.plane == "lint":  # offline: AST checks, no cluster
         from .analysis.runner import run_cli
 

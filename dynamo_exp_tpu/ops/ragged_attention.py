@@ -58,7 +58,13 @@ def ragged_supported(
     tile-aligned, so the collapsed lane dim (Hkv*D) must be a multiple
     of 128 and the page size a multiple of the sublane tile. Callers
     fall back to the pure-JAX reference otherwise (interpret mode has
-    no such constraint)."""
+    no such constraint).
+
+    This gate is part of the *resolved* attention implementation, which
+    is part of the AOT compile-manifest key (docs/aot.md): a layout
+    that resolves differently on another host produces a different
+    manifest hash, so a warm boot can never load executables built for
+    the other implementation."""
     sublane = 16 if jnp.dtype(kv_dtype).itemsize == 2 else 8
     return (num_kv_heads * head_dim) % 128 == 0 and page_size % sublane == 0
 

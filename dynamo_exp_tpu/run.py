@@ -81,6 +81,18 @@ def parse_args(argv: list[str]):
     p.add_argument("--spec-ngram", type=int, default=3,
                    help="widest trailing n-gram the prompt-lookup "
                         "drafter matches")
+    # AOT warm boot (docs/aot.md): precompile/load the engine's whole
+    # compile lattice BEFORE the endpoint registers, so the first
+    # request of every shape is steady-state fast. With a populated
+    # persistent compilation cache (llmctl aot compile) the compiles
+    # are deserializations and scale-up collapses to program-load time.
+    p.add_argument("--prewarm", action="store_true",
+                   help="prewarm the engine's compile lattice before "
+                        "serving (docs/aot.md warm boot)")
+    p.add_argument("--compile-cache-dir",
+                   default=os.environ.get("DYN_COMPILE_CACHE", ""),
+                   help="JAX persistent compilation cache directory "
+                        "(default: $DYN_COMPILE_CACHE; empty = uncached)")
     p.add_argument("--echo-token-delay-ms", type=float, default=0.0)
     p.add_argument("--request-template", default="",
                    help="JSON file of request defaults (model/temperature/"
@@ -234,7 +246,22 @@ def build_tpu_engine(opts):
         spec_max_draft=getattr(opts, "spec_max_draft", 8),
         spec_ngram=getattr(opts, "spec_ngram", 3),
     )
+    cache_dir = getattr(opts, "compile_cache_dir", "")
+    if cache_dir:
+        from .aot import enable_persistent_cache
+
+        enable_persistent_cache(cache_dir)
     engine = TPUEngine(ecfg, params=params)
+    if getattr(opts, "prewarm", False):
+        # Warm boot (docs/aot.md): the lattice compiles/loads NOW, not
+        # under first traffic — with a populated cache this is seconds,
+        # and the compile-miss counters stay flat from the first
+        # dispatch.
+        report = engine.prewarm(cache_dir=cache_dir)
+        logger.info(
+            "prewarmed %d variants in %.2fs (manifest %s)",
+            report.variants, report.seconds, report.manifest_hash[:12],
+        )
     return engine, mdc
 
 

@@ -18,6 +18,7 @@ from .report import SimReport
 from .workload import (
     SimRequest,
     burst_workload,
+    diurnal_workload,
     load_trace,
     ramp_workload,
     save_trace,
@@ -33,6 +34,7 @@ __all__ = [
     "LatencyDist",
     "SimRequest",
     "burst_workload",
+    "diurnal_workload",
     "ramp_workload",
     "synthetic_users",
     "load_trace",

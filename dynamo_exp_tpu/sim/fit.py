@@ -83,7 +83,12 @@ class ServiceTimeModel:
     prefill_floor_s: float = 0.01  # dispatch floor for tiny prompts
     itl_s: LatencyDist = field(default_factory=lambda: LatencyDist(0.02))
     batch_congestion: float = 0.25
-    provision_s: float = 2.0  # worker add → serving (planner sees this)
+    # Worker add → serving (the planner's SloTargets.provision_s hint).
+    # Fitted from ``bench.py --coldstart-sweep`` lines, which tag each
+    # sample ``prewarmed: true|false`` (docs/aot.md): warm (prewarmed)
+    # samples win when present — a fleet that warm-boots its instances
+    # must plan with the warm landing delay, not the cold one.
+    provision_s: float = 2.0
     # Speculative decoding (docs/speculative.md): tokens emitted per
     # decode dispatch per row (accepted draft prefix + correction).
     # ``itl_s`` is normalized to the per-*dispatch* interval — equal to
@@ -145,7 +150,7 @@ class ServiceTimeModel:
     ) -> "ServiceTimeModel":
         """Fit from ``bench.py`` JSON lines, or the driver's
         ``BENCH_r*.json`` wrapper (a dict with a ``parsed`` record)."""
-        prefill_per_token, itl, tpd = _bench_samples(paths)
+        prefill_per_token, itl, tpd, provision = _bench_samples(paths)
         model = cls.default()
         if itl:
             model.itl_s = LatencyDist.fit(itl)
@@ -153,6 +158,7 @@ class ServiceTimeModel:
             model.prefill_token_s = LatencyDist.fit(prefill_per_token)
         if tpd:
             model.spec_tokens_per_dispatch = _median(tpd)
+        _fit_provision(model, provision)
         return model
 
     @classmethod
@@ -163,8 +169,8 @@ class ServiceTimeModel:
     ) -> "ServiceTimeModel":
         """Spans win where both sources speak (they are per-request
         measurements; bench numbers are aggregates)."""
-        bench_p, bench_i, bench_t = (
-            _bench_samples(bench_paths) if bench_paths else ([], [], [])
+        bench_p, bench_i, bench_t, bench_prov = (
+            _bench_samples(bench_paths) if bench_paths else ([], [], [], [])
         )
         span_p, span_i, span_t = (
             _span_samples(span_paths) if span_paths else ([], [], [])
@@ -179,12 +185,27 @@ class ServiceTimeModel:
             model.itl_s = LatencyDist.fit(itl)
         if tpd:
             model.spec_tokens_per_dispatch = _median(tpd)
+        _fit_provision(model, bench_prov)
         return model
 
 
 def _median(samples: list[float]) -> float:
     s = sorted(samples)
     return s[len(s) // 2]
+
+
+def _fit_provision(
+    model: ServiceTimeModel, samples: list[tuple[bool, float]]
+) -> None:
+    """Fold ``(prewarmed, provision_s)`` samples from coldstart bench
+    lines into the model: warm-boot samples win over cold ones (a fleet
+    that prewarms plans with the warm landing delay; the cold samples
+    are its baseline, not its operating point)."""
+    warm = [s for pre, s in samples if pre]
+    cold = [s for pre, s in samples if not pre]
+    chosen = warm or cold
+    if chosen:
+        model.provision_s = _median(chosen)
 
 
 def _span_samples(
@@ -254,10 +275,13 @@ def _span_samples(
 
 def _bench_samples(
     paths: Iterable[str | Path],
-) -> tuple[list[float], list[float], list[float]]:
+) -> tuple[
+    list[float], list[float], list[float], list[tuple[bool, float]]
+]:
     itl: list[float] = []
     prefill_per_token: list[float] = []
     tpd: list[float] = []
+    provision: list[tuple[bool, float]] = []
     for path in paths:
         text = Path(path).read_text().strip()
         records: list[dict] = []
@@ -286,6 +310,14 @@ def _bench_samples(
                 continue
             if value <= 0:
                 continue
+            # Coldstart lines (bench.py --coldstart-sweep): every line
+            # carries ``prewarmed: bool`` + ``provision_s`` so the fit
+            # can tell a warm-boot landing delay from a cold one
+            # (docs/aot.md). These lines have no throughput metric —
+            # fall through so their dispatch percentiles still fit ITL.
+            prov = rec.get("provision_s")
+            if isinstance(prov, (int, float)) and prov > 0:
+                provision.append((bool(rec.get("prewarmed")), float(prov)))
             m = re.search(r"_c(\d+)$", metric) or re.search(
                 r"_a(\d+)of\d+$", metric
             )
@@ -333,4 +365,4 @@ def _bench_samples(
                 spec, (int, float)
             ) and spec > 0:
                 tpd.append(float(spec))
-    return prefill_per_token, itl, tpd
+    return prefill_per_token, itl, tpd, provision

@@ -11,6 +11,8 @@ Three shapes, all seeded and deterministic:
   processes (exponential inter-arrivals under a rate profile) for
   planner studies and fleet-scale runs. ``synthetic_users`` is a lazy
   generator: a million users never materialize as a list.
+- :func:`diurnal_workload` — periodic burst (half-sine between a base
+  and a peak rate): the coldstart/provisioning study (docs/aot.md).
 - :func:`load_trace` / :func:`save_trace` — JSONL trace files
   (one request per line: ``arrival_s``, ``prompt_len``,
   ``max_tokens``, ``priority``), the recorded-workload interchange
@@ -111,6 +113,45 @@ def ramp_workload(
             max_tokens=max_tokens,
         )
     )
+
+
+def diurnal_workload(
+    seed: int,
+    duration_s: float = 600.0,
+    rps_base: float = 1.0,
+    rps_peak: float = 10.0,
+    period_s: float = 300.0,
+    prompt_len: tuple[int, int] = (64, 512),
+    max_tokens: tuple[int, int] = (16, 128),
+) -> list[SimRequest]:
+    """Periodic burst: arrival rate swings between ``rps_base`` and
+    ``rps_peak`` along a half-sine each ``period_s`` (burst, trough,
+    burst, …) — the provisioning-study workload (docs/aot.md
+    "Coldstart study"). How many standby chips the fleet needs to
+    absorb the rising edge of each burst is exactly a function of
+    ``provision_s``: a cold fleet must scale before the edge (or eat
+    SLO violations), a warm fleet can scale on it."""
+    rng = random.Random(seed)
+    out: list[SimRequest] = []
+    t = 0.0
+    i = 0
+    while t < duration_s:
+        phase = math.sin(2.0 * math.pi * t / period_s)
+        rate = rps_base + (rps_peak - rps_base) * max(phase, 0.0)
+        t += -math.log(1.0 - rng.random()) / max(rate, 1e-9)
+        if t >= duration_s:
+            break
+        out.append(
+            SimRequest(
+                index=i,
+                arrival_s=t,
+                prompt_len=rng.randint(*prompt_len),
+                max_tokens=rng.randint(*max_tokens),
+                priority=_draw_priority(rng),
+            )
+        )
+        i += 1
+    return out
 
 
 def synthetic_users(

@@ -143,6 +143,19 @@ class DispatchProfiler:
         self._seen_variants.add(k)
         return True
 
+    def seed_variants(self, family: str, keys) -> None:
+        """Warm-boot seeding (docs/aot.md): mark (family, key) variants
+        as already-compiled so their first *traffic* dispatch is never
+        charged as a cold compile. The freshness heuristics predate
+        prewarm — without this, a prewarmed gather/scatter bucket's
+        first live dispatch would read as a miss and break the
+        flat-from-first-dispatch guarantee the prewarm-smoke gate
+        asserts. (The engine's ragged cache needs no seeding: its
+        freshness is a cache-size delta, and prewarm populates the
+        cache itself.)"""
+        for key in keys:
+            self._seen_variants.add((family, key))
+
     # ------------------------------------------------------------- consume
     def consume(self, kind: str, t_dispatch: float) -> None:
         """Immediately after the dispatch's existing host sync. Records

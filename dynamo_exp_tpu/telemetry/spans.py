@@ -334,8 +334,27 @@ class Telemetry:
         self.compile_cache_misses = Counter(
             "dynamo_compile_cache_misses_total",
             "Compiled-variant cache misses, by dispatch kind (steady "
-            "state should stop incrementing — see the recompile guard)",
+            "state should stop incrementing — see the recompile guard; "
+            "a warm boot starts flat at 0, docs/aot.md)",
             ["kind"],
+            registry=self.registry,
+        )
+        # Warm-boot provisioning (docs/aot.md): how long prewarm() took
+        # to load/compile the lattice before first traffic, and how
+        # many variants it covered per family. Prewarm work is recorded
+        # HERE, never as compile-cache misses — miss counters measure
+        # steady-state flatness, which a warm boot holds from the very
+        # first dispatch.
+        self.prewarm_seconds = Gauge(
+            "dynamo_prewarm_seconds",
+            "Wall time of the engine's warm-boot prewarm (0 = cold boot)",
+            registry=self.registry,
+        )
+        self.prewarm_variants = Counter(
+            "dynamo_prewarm_variants_total",
+            "Compiled variants loaded/built by warm-boot prewarm, by "
+            "family",
+            ["kind"],  # ragged | move
             registry=self.registry,
         )
         # SLO/goodput attribution (docs/observability.md "SLO

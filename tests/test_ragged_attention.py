@@ -501,7 +501,15 @@ def test_steady_state_variant_count_small_constant():
     """The collapsed lattice in numbers: a full mixed workload
     envelope (both sampler partitions, all occupancies, staggered
     arrivals) compiles a small constant number of ragged variants, and
-    steady-state traffic never grows the cache again."""
+    steady-state traffic never grows the cache again.
+
+    The expected set is enumerated from ``aot/lattice.py`` — the SAME
+    key function the engine's ``_ragged_fn`` dispatches through — so
+    the offline lattice is regression-pinned against the live engine:
+    any compiled key the manifest failed to enumerate fails here before
+    it can become a prewarm blind spot (docs/aot.md)."""
+    from dynamo_exp_tpu.aot import manifest_for_engine
+
     eng = _mixed_engine(max_decode_slots=4)
     eng.start()
     try:
@@ -532,8 +540,16 @@ def test_steady_state_variant_count_small_constant():
             if len(eng._ragged_fns) == before:
                 break
         variants = len(eng._ragged_fns)
-        # Small constant: one (tokens, pages, windowed, sampler, lp)
-        # lattice for everything the envelope serves.
+        # Every live-compiled key must be a member of the offline
+        # lattice (the warm-boot manifest covers everything the loop
+        # can dispatch) ...
+        lattice = manifest_for_engine(eng).ragged_keys()
+        stray = set(eng._ragged_fns) - lattice
+        assert not stray, f"keys the AOT lattice failed to enumerate: {stray}"
+        # ... and the envelope's compiled subset stays a small constant
+        # (well under the full lattice: traffic only walks the shapes
+        # it needs).
+        assert variants <= len(lattice), (variants, len(lattice))
         assert variants <= 16, dict.fromkeys(eng._ragged_fns)
         for _ in range(3):
             asyncio.run(mix(2, 2))
