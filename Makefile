@@ -23,7 +23,7 @@ SIM_SEED_SETS := 7,21,1337 3,9,27
 # must stay token-identical with spec on (docs/speculative.md).
 SPEC_SEED_SETS := 7,21,1337
 
-.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke
+.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare
 
 test:
 	$(PYTEST) tests/ -q -m "not tpu and not weekly"
@@ -54,6 +54,10 @@ chaos:
 	for seeds in $(SPEC_SEED_SETS); do \
 		echo "=== spec-on identity suites (DYN_SPEC=ngram), CHAOS_SEEDS=$$seeds ==="; \
 		env DYN_SPEC=ngram CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_resumable.py tests/test_overload.py -q -m "not slow"; \
+	done; \
+	for seeds in $(CHAOS_SEED_SETS); do \
+		echo "=== KV conservation ledger suite, CHAOS_SEEDS=$$seeds ==="; \
+		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_kv_ledger.py -q -m chaos; \
 	done
 
 # Seeded simulator regression sets (mirrors `make chaos`): every seed
@@ -98,3 +102,15 @@ prewarm-smoke:
 lint:
 	ruff check dynamo_exp_tpu/ tests/ bench.py __graft_entry__.py
 	python -m dynamo_exp_tpu.llmctl lint --json
+
+# Bench regression comparator (docs/observability.md "Fleet plane"):
+# compare the two newest checked-in BENCH_r*.json captures and fail on
+# >10% tok/s or TTFT/ITL regressions per metric. Platform-tag aware:
+# chip lines never compare against CPU-fallback lines, and captures
+# with no comparable pairs (failed runs — the tunnel has been down
+# since r02) compare clean. Runs pre-merge (pre-merge.yml).
+bench-compare:
+	@files=$$(ls BENCH_r*.json 2>/dev/null | sort | tail -2); \
+	set -- $$files; \
+	if [ $$# -lt 2 ]; then echo "fewer than two BENCH_r*.json files; nothing to compare"; exit 0; fi; \
+	python -m dynamo_exp_tpu.llmctl bench compare $$1 $$2

@@ -36,6 +36,11 @@ HOT_PATH_ZONES: tuple[Zone, ...] = (
     # (docs/observability.md); the checker turns that claim into a
     # standing property instead of one driven smoke test.
     Zone("dynamo_exp_tpu/telemetry/dispatch.py"),
+    # The fleet plane's transfer ledger is recorded from the KV
+    # transfer paths and the conservation auditor runs inside the
+    # engine loop (docs/observability.md "Fleet plane"): both must stay
+    # pure host bookkeeping — no device value may ever reach them.
+    Zone("dynamo_exp_tpu/telemetry/fleet.py"),
 )
 
 # ------------------------------------------------------ determinism zones
@@ -105,6 +110,14 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_last_gauge_pub",
                 "_last_reap",
                 "_pub_prefix_hits",  # gauge-publish counter snapshots
+                # KV conservation auditor (docs/observability.md "KV
+                # conservation auditor"): the in-loop check's episode
+                # state and violation counter, plus the open lease-span
+                # map (grant, confirm, and reap all run on the loop).
+                "kv_ledger_violations",
+                "_ledger_last",
+                "_ledger_dumped",
+                "_lease_traces",
             }
         ),
         handoff=frozenset(
@@ -129,6 +142,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "cfg",
                 "mesh",
                 "_seed_rng",  # submission-side only (asyncio threads)
+                "_build_info",  # written once in __init__, read-only after
                 "_gather_pages",
                 "_inject_pages",
                 "_cow_pages",
@@ -156,6 +170,15 @@ LOCK_MANIFESTS: tuple[LockManifest, ...] = (
         cls="FlightRecorder",
         lock="_lock",
         guarded=frozenset({"_ring", "_head", "seq"}),
+    ),
+    LockManifest(
+        # The fleet transfer ledger: recorded from asyncio transfer
+        # paths, snapshotted from serving/scraper threads — every
+        # ``_links`` access sits under the lock.
+        path="dynamo_exp_tpu/telemetry/fleet.py",
+        cls="TransferLedger",
+        lock="_lock",
+        guarded=frozenset({"_links"}),
     ),
     LockManifest(
         path="dynamo_exp_tpu/telemetry/slo.py",

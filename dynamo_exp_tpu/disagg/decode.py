@@ -18,7 +18,7 @@ from ..protocols.common import BackendInput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from ..runtime.health import CircuitBreaker
 from ..runtime.transports.base import WorkQueue
-from ..telemetry import span as trace_span
+from ..telemetry import get_telemetry, span as trace_span
 from .config import DisaggConfigWatcher
 from .protocol import RemotePrefillRequest, kv_signature
 from .transfer import KvPageReceiver
@@ -161,6 +161,10 @@ class DisaggDecodeEngine(AsyncEngine):
                 parent_span_id=sp.context.span_id,
                 deadline_unix=ctx.deadline or 0.0,
                 skip_blocks=skip,
+                # Per-link transfer ledger: the prefill worker records
+                # the (src, dst) link by instance name, not by this
+                # process's ephemeral receiver port.
+                decode_instance=get_telemetry().instance,
             )
             try:
                 await self.queue.push(req.to_bytes())

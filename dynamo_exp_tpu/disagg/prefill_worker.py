@@ -137,7 +137,7 @@ class PrefillWorker:
             try:
                 await send_kv_pages(
                     req.return_addr, req.request_id, first_token, pages,
-                    lease=lease,
+                    lease=lease, dst_instance=req.decode_instance,
                 )
                 # Delivery acked end-to-end: the decode side owns a host
                 # copy of every page, so the handoff lease is confirmed

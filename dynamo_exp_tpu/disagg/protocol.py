@@ -62,6 +62,12 @@ class RemotePrefillRequest:
     # worker ignores the field and ships everything; the decode side
     # detects the full-length reply and injects from page 0.
     skip_blocks: int = 0
+    # Fleet observability (docs/observability.md "Fleet plane"): the
+    # requesting decode worker's instance identity, so the prefill
+    # worker's TransferLedger records the (src, dst) link by *name*
+    # (the return_addr is an ephemeral host:port). Older senders leave
+    # it empty; the ledger falls back to the return address.
+    decode_instance: str = ""
 
     def to_bytes(self) -> bytes:
         return json.dumps(asdict(self)).encode()

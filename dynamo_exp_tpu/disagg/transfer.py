@@ -39,6 +39,7 @@ from ..runtime.transports.codec import (
     write_message,
 )
 from ..telemetry import TraceContext, current_trace, get_telemetry, wire_headers
+from ..telemetry.fleet import get_transfer_ledger
 
 logger = logging.getLogger(__name__)
 
@@ -93,6 +94,7 @@ async def send_kv_pages(
     chunk_pages: int = DEFAULT_CHUNK_PAGES,
     window: int = DEFAULT_WINDOW,
     lease: "object | None" = None,  # disagg.protocol.LeaseGrant
+    dst_instance: str = "",
 ) -> None:
     """Deliver one prefill result (or failure notice) to a decode worker.
 
@@ -103,6 +105,11 @@ async def send_kv_pages(
     the source pages under a handoff lease) rides the BEGIN frame so the
     receive side can trace which lease covered the transfer; a clean
     final ack is the sender's cue to confirm the lease.
+
+    ``dst_instance`` names the receiving decode worker for the per-link
+    :class:`~dynamo_exp_tpu.telemetry.fleet.TransferLedger` (falls back
+    to the return address); the sender's own instance identity rides
+    the BEGIN frame so the receive side ledgers the same link by name.
     """
     host, _, port = return_addr.rpartition(":")
     t0 = time.time()
@@ -147,6 +154,10 @@ async def send_kv_pages(
             "kind": "begin",
             "n_pages": len(pages),
             "n_chunks": len(chunks),
+            # The sending instance's identity: the receive side ledgers
+            # the (src, dst) link by name (docs/observability.md
+            # "Fleet plane").
+            "src_instance": tel.instance,
         }
         # The receiver's transfer span joins the sender's trace.
         trace = wire_headers()
@@ -185,6 +196,9 @@ async def send_kv_pages(
         tel.kv_transfer_duration.labels("send").observe(end - t0)
         tel.kv_transfer_bytes.labels("send").observe(total_bytes)
         tel.kv_transfer_total.labels("send", "ok").inc()
+        # Per-link ledger: the sender's extract->ack view of the link.
+        dst = dst_instance or return_addr
+        get_transfer_ledger().record(tel.instance, dst, total_bytes, end - t0)
         tel.emit_stage(
             "kv_transfer_send",
             t0,
@@ -193,6 +207,8 @@ async def send_kv_pages(
             request_id=request_id,
             pages=len(pages),
             bytes=total_bytes,
+            src=tel.instance,
+            dst=dst,
         )
     except BaseException:
         tel.kv_transfer_total.labels("send", "error").inc()
@@ -307,6 +323,14 @@ class KvPageReceiver:
                 tel.kv_transfer_duration.labels("recv").observe(end - t0)
                 tel.kv_transfer_bytes.labels("recv").observe(n_bytes)
                 tel.kv_transfer_total.labels("recv", "ok").inc()
+                # Per-link ledger, receive-side view: in a real fleet
+                # each process only ever sees its own side of a link, so
+                # the decode worker learns inbound bandwidth without a
+                # cross-instance scrape.
+                src = begin_header.get("src_instance") or "?"
+                get_transfer_ledger().record(
+                    src, tel.instance, n_bytes, end - t0
+                )
                 tel.emit_stage(
                     "kv_transfer_recv",
                     t0,
@@ -315,6 +339,8 @@ class KvPageReceiver:
                     request_id=rid,
                     pages=n_pages,
                     bytes=n_bytes,
+                    src=src,
+                    dst=tel.instance,
                     # Which handoff lease covered this transfer (tracing
                     # orphan reclaims back to their request).
                     lease_id=begin_header.get("lease_id"),

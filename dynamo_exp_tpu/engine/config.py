@@ -140,6 +140,14 @@ class EngineConfig:
     # between extract and inject) once it passes. Must comfortably cover
     # one prefill-to-decode transfer (docs/fault_tolerance.md).
     kv_lease_ttl_s: float = 30.0
+    # KV conservation auditor (docs/observability.md "KV conservation
+    # auditor"): run the page manager's O(1) counter-delta ledger check
+    # every loop iteration; a violation increments
+    # dynamo_kv_ledger_violations_total and dumps a flight snapshot
+    # (with the full named audit) once per episode. Pure host-int
+    # arithmetic — zero added host syncs (sync-spy-proven). Off only
+    # for A/B overhead measurement.
+    kv_ledger_check: bool = True
 
     def __post_init__(self):
         if not self.prefill_buckets:

@@ -94,6 +94,14 @@ from .scheduler import RemoteKv, Scheduler, SeqState, Sequence
 
 log = logging.getLogger(__name__)
 
+# Process-wide KV-ledger violation registry (docs/observability.md "KV
+# conservation auditor"): every engine appends the violations its
+# in-loop check or stop-time audit observed. The test suites' autouse
+# guard asserts this stays empty across every chaos / overload /
+# prefix-sharing / resumable scenario — turning the trickiest page
+# state machines into a continuously-checked invariant.
+LEDGER_VIOLATIONS: list[str] = []
+
 
 def resolve_attn_impl(cfg: EngineConfig, mesh: Mesh) -> tuple[str, bool]:
     """Pick the decode attention implementation. ``auto`` resolves to
@@ -406,6 +414,29 @@ class TPUEngine(AsyncEngine):
         # page manager itself is telemetry-free; COW has its own event-
         # site counter in _resolve_shared_tail).
         self._pub_prefix_hits = {"shared": 0, "restore": 0, "miss": 0}
+        # KV conservation auditor (docs/observability.md "KV
+        # conservation auditor"): the loop runs the page manager's O(1)
+        # counter-delta check every iteration; a *new* violation set
+        # (not the same broken state re-observed) counts, dumps a
+        # flight snapshot with the full named audit, and lands in the
+        # module-level LEDGER_VIOLATIONS registry the test suites
+        # police.
+        self.kv_ledger_violations = 0
+        self._ledger_last: tuple = ()
+        self._ledger_dumped = False
+        # Open KV-handoff lease spans: lease_id -> (TraceContext, grant
+        # unix time), closed at confirm/reap so `llmctl trace` shows
+        # lease grant -> confirm | reap as one hop of the request's
+        # timeline. Loop-owned (grant, confirm, and reap all run here).
+        self._lease_traces: dict[str, tuple] = {}
+        # Fleet build-info (docs/observability.md "Fleet plane"): the
+        # AOT lattice manifest hash + jax version + feature flags, so
+        # fleet scrapes can detect config skew between instances.
+        # Computed AND published once in the single-threaded
+        # construction window — the engine starts lazily on first
+        # traffic, and a scrape must see the fingerprint from boot.
+        self._build_info = self._compute_build_info()
+        get_telemetry().set_build_info(**self._build_info)
 
     # ----------------------------------------------------------- compiled fns
     def _resolve_attn(self) -> tuple[str, bool]:
@@ -796,6 +827,27 @@ class TPUEngine(AsyncEngine):
                 return
             self._thread = None
         self._inflight = None  # dynlint: thread-ownership(loop thread joined before teardown flush)
+        # Final conservation audit (the loop thread is joined, so the
+        # page ledger is quiescent): any violation the in-loop check
+        # missed — or one that appeared in the teardown path itself —
+        # still lands in the registry the test suites police.
+        if self.cfg.kv_ledger_check:
+            # Only violation KINDS the in-loop check has NOT already
+            # counted (a persistent episode must not double-report at
+            # teardown; the strings embed counter values, so kind-level
+            # comparison is the stable one).
+            seen = set(self._ledger_last)
+            final = [
+                v
+                for v in self.kv.ledger_check()
+                if v.split(":", 1)[0] not in seen
+            ]
+            if final:
+                self.kv_ledger_violations += len(final)  # dynlint: thread-ownership(loop thread joined before teardown flush)
+                LEDGER_VIOLATIONS.extend(final)
+                get_telemetry().kv_ledger_violations.inc(len(final))
+                for v in final:
+                    log.error("KV ledger violation at stop: %s", v)
         # Prefix-pin requests queued after the loop's last service pass
         # must not hang their callers (disagg routing awaits them).
         self._drain_pin_q()
@@ -1028,7 +1080,7 @@ class TPUEngine(AsyncEngine):
             return (0, None)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self._pin_q.put((list(token_ids), loop, fut))
+        self._pin_q.put((list(token_ids), loop, fut, current_trace()))
         self._wake.set()
         if not self._running and not fut.done():
             # stop() drained the queue before our put landed: nothing
@@ -1042,7 +1094,7 @@ class TPUEngine(AsyncEngine):
         *filled* prefix (bytes that exist on device now) and pin it."""
         while True:
             try:
-                tokens, loop, fut = self._pin_q.get_nowait()
+                tokens, loop, fut, trace = self._pin_q.get_nowait()
             except queue.Empty:
                 return
             pages, _ = self.kv.match_prefix(tokens, require_filled=True)
@@ -1051,6 +1103,8 @@ class TPUEngine(AsyncEngine):
                 if pages
                 else None
             )
+            if lease is not None and trace is not None:
+                self._lease_traces[lease] = (trace, time.time())
             result = (len(pages), lease)
 
             def resolve(f=fut, r=result, lease=lease):
@@ -1068,6 +1122,7 @@ class TPUEngine(AsyncEngine):
             except RuntimeError:  # caller's loop closed: release the pin
                 if lease is not None:
                     self.kv.confirm_lease(lease)
+                    self._close_lease_span(lease, "confirmed")
 
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
@@ -1097,6 +1152,12 @@ class TPUEngine(AsyncEngine):
                 # writer — every iteration, busy or idle.
                 self._service_leases()
                 self._service_pins()
+                # Conservation auditor: O(1) counter arithmetic over the
+                # page ledger, every iteration, busy or idle — a leaked
+                # ref or double-release is caught within one loop pass
+                # of the mutation that caused it.
+                if self.cfg.kv_ledger_check:
+                    self._check_ledger()
                 if self._inflight is not None:
                     # Steady state: launch the next window device-to-
                     # device, then consume the previous one while the
@@ -1275,6 +1336,11 @@ class TPUEngine(AsyncEngine):
                 "pages_total": self.kv.num_pages,
                 "inflight_window": self._inflight is not None,
                 "progress_mark": self._progress_mark,
+                # Full named conservation audit (docs/observability.md
+                # "KV conservation auditor"): `llmctl audit <dump>`
+                # renders this block, so the snapshot a ledger violation
+                # dumps already names the leaking sequence/lease.
+                "kv_audit": self.kv_audit(),
             }
         except Exception:  # noqa: BLE001 - snapshot is best-effort
             log.exception("flight snapshot failed")
@@ -1311,7 +1377,9 @@ class TPUEngine(AsyncEngine):
         pages within one lease period."""
         while True:
             try:
-                self.kv.confirm_lease(self._lease_confirm_q.get_nowait())
+                lid = self._lease_confirm_q.get_nowait()
+                self.kv.confirm_lease(lid)
+                self._close_lease_span(lid, "confirmed")
                 if self.flight is not None:
                     self.flight.record("lease_confirm")
             except queue.Empty:
@@ -1319,6 +1387,8 @@ class TPUEngine(AsyncEngine):
         if self.kv.active_leases:
             reclaimed = self.kv.reap_expired()
             if reclaimed:
+                for lid, pages in self.kv.last_reaped:
+                    self._close_lease_span(lid, "reaped", pages=pages)
                 if self.flight is not None:
                     self.flight.record("lease_reap", pages=reclaimed)
                 get_telemetry().kv_lease_reclaims.inc(reclaimed)
@@ -1326,6 +1396,104 @@ class TPUEngine(AsyncEngine):
                     "reaped %d KV pages from expired handoff leases "
                     "(decode side never confirmed delivery)", reclaimed,
                 )
+
+    def _close_lease_span(
+        self, lease_id: str, outcome: str, pages: int | None = None
+    ) -> None:
+        """Close a KV-handoff lease's trace hop: one ``kv_lease`` span
+        from grant to confirm/reap, parented into the request's trace —
+        `llmctl trace <id>` shows the lease lifecycle next to the
+        extract→transfer→inject hops. Loop-thread only (grant, confirm,
+        and reap all run here); leases granted without a trace (or from
+        another engine) are a no-op."""
+        entry = self._lease_traces.pop(lease_id, None)
+        if entry is None:
+            return
+        trace, granted_at = entry
+        get_telemetry().emit_stage(
+            "kv_lease",
+            granted_at,
+            time.time(),
+            trace,
+            lease_id=lease_id,
+            outcome=outcome,
+            pages=pages,
+        )
+
+    @staticmethod
+    def _violation_kinds(violations: list[str]) -> tuple:
+        """Value-free episode signature: the invariant *kinds* broken
+        (the text before the ':' — 'page conservation broken', …). The
+        messages embed live counter values that legitimately shift
+        every iteration while the engine keeps serving, so deduping on
+        the full strings would re-count one persistent defect at loop
+        frequency."""
+        return tuple(sorted({v.split(":", 1)[0] for v in violations}))
+
+    def _check_ledger(self) -> None:
+        """One in-loop conservation check (docs/observability.md "KV
+        conservation auditor"). Only a *new* violation-kind set counts —
+        a persistently broken invariant re-observed each iteration
+        (with drifting counter values) must not melt the counter — and
+        the first violation of an episode dumps a flight snapshot
+        carrying the full named audit."""
+        violations = self.kv.ledger_check()
+        sig = self._violation_kinds(violations)
+        if sig == self._ledger_last:
+            return
+        self._ledger_last = sig
+        if not violations:
+            self._ledger_dumped = False  # episode over: re-arm the dump
+            return
+        self.kv_ledger_violations += len(violations)
+        LEDGER_VIOLATIONS.extend(violations)
+        get_telemetry().kv_ledger_violations.inc(len(violations))
+        for v in violations:
+            log.error("KV ledger violation: %s", v)
+        if self.flight is not None:
+            self.flight.record(
+                "ledger_violation", count=len(violations)
+            )
+        if not self._ledger_dumped:
+            self._ledger_dumped = True
+            self._dump_flight("kv_ledger")
+
+    def kv_audit(self) -> dict:
+        """Full on-demand conservation audit: every page classified into
+        exactly one of {free, parked, active, leased, shared@ref>=2},
+        refcounts cross-checked against the live holder set (bound
+        sequences by ``seq:<request_id>``, handoff/pin leases by
+        ``lease:<id>``), so a leak is *named*. Read-only — rides the
+        flight snapshot (``llmctl audit <dump>`` renders it) and the
+        stop()-time final check."""
+        holders: dict[str, list[int]] = {}
+        for s in self.sched.slots:
+            if s is not None and s.page_ids:
+                holders[f"seq:{s.request_id}"] = list(s.page_ids)
+        for s in self.sched.waiting:
+            if getattr(s, "page_ids", None):
+                holders[f"seq:{s.request_id}"] = list(s.page_ids)
+        return self.kv.audit(holders)
+
+    def _compute_build_info(self) -> dict:
+        """Config-skew fingerprint for fleet scrapes: the AOT lattice
+        manifest hash (the compile-identity of this engine shape,
+        docs/aot.md), the jax version, and the feature flags that change
+        serving behavior. Mirrored as the dynamo_build_info gauge and
+        the ``build_info`` metrics() key."""
+        try:
+            from ..aot.compile import manifest_for_engine
+
+            manifest_hash = manifest_for_engine(self).hash()
+        except Exception:  # noqa: BLE001 - fingerprint is best-effort
+            log.warning("build-info manifest hash failed", exc_info=True)
+            manifest_hash = ""
+        return {
+            "manifest_hash": manifest_hash,
+            "jax_version": jax.__version__,
+            "prefix_sharing": bool(self.cfg.prefix_sharing),
+            "spec": self.cfg.spec_mode,
+        }
 
     def _drain_submissions(self) -> None:
         while True:
@@ -1430,7 +1598,7 @@ class TPUEngine(AsyncEngine):
         and crash paths must never strand one."""
         while not self._pin_q.empty():
             try:
-                _tokens, loop, fut = self._pin_q.get_nowait()
+                _tokens, loop, fut, _trace = self._pin_q.get_nowait()
             except queue.Empty:
                 break
             try:
@@ -1653,6 +1821,11 @@ class TPUEngine(AsyncEngine):
             )
         get_telemetry().kv_page_moves.labels("extract").inc(len(pids))
         lease_id = self.kv.grant_lease(pids, self.cfg.kv_lease_ttl_s)
+        if seq.trace is not None:
+            # Open the lease's trace hop: closed (one kv_lease span)
+            # when the delivery ack confirms it or the reaper reclaims
+            # it, so `llmctl trace` shows grant -> confirm | reap.
+            self._lease_traces[lease_id] = (seq.trace, time.time())
         if self.flight is not None:
             self.flight.record(
                 "lease_grant", req=seq.request_id, pages=len(pids)
@@ -1680,6 +1853,7 @@ class TPUEngine(AsyncEngine):
             # fall back); either way the routing-time pin has done its
             # job. The sequence's own refs keep the pages alive now.
             self.kv.confirm_lease(rk.pin_lease)
+            self._close_lease_span(rk.pin_lease, "confirmed")
             rk.pin_lease = None
         n_pages = (len(seq.prompt) + ps - 1) // ps
         if rk.skip_pages and seq.cached_len // ps < rk.skip_pages:
@@ -2701,4 +2875,14 @@ class TPUEngine(AsyncEngine):
             m["host_cache_resident"] = self.host_pool.resident
             m["host_cache_hits"] = self.host_pool.hits
             m["host_cache_stores"] = self.host_pool.stores
+        # Fleet observability plane (docs/observability.md "Fleet
+        # plane"): conservation-auditor violations (0 in any healthy
+        # run), the config-skew fingerprint, and this process's per-link
+        # KV transfer ledger — the exact surface FleetAggregator rolls
+        # up across instances.
+        m["kv_ledger_violations"] = self.kv_ledger_violations
+        m["build_info"] = dict(self._build_info)
+        from ..telemetry.fleet import get_transfer_ledger
+
+        m["kv_links"] = get_transfer_ledger().snapshot()
         return m

@@ -190,6 +190,19 @@ class KvPageManager:
         # gauge and the bench's high-water never scan the pool.
         self.live_shared = 0
         self.peak_shared_pages = 0
+        # Conservation ledger (docs/observability.md "KV conservation
+        # auditor"): pages currently referenced (ref_count >= 1) and the
+        # refcount grand total, both maintained at the SAME transitions
+        # that move pages between the free list, the parked LRU, and the
+        # held set — so ``ledger_check`` is pure counter arithmetic
+        # (O(1), no pool scan, no device work). A double-release or a
+        # lost reference breaks the arithmetic within the very mutation
+        # that caused it.
+        self._held_pages = 0
+        self._ref_total = 0
+        # Leases reclaimed by the most recent reap_expired() call —
+        # (lease_id, pages) pairs the engine reads to close lease spans.
+        self.last_reaped: list[tuple[str, int]] = []
 
     # ---------------------------------------------------------------- stats
     @property
@@ -518,8 +531,11 @@ class KvPageManager:
             rec = self._records[pid]
             if rec.ref_count > 0:
                 rec.ref_count -= 1
+                self._ref_total -= 1
                 if rec.ref_count == 1:
                     self.live_shared -= 1
+                if rec.ref_count == 0:
+                    self._held_pages -= 1
             if rec.ref_count == 0:
                 if rec.seq_hash is not None and rec.filled:
                     self._reclaimable[pid] = None
@@ -564,17 +580,153 @@ class KvPageManager:
 
     def reap_expired(self, now: float | None = None) -> int:
         """Reclaim every expired lease's pages; returns pages freed.
-        Engine-loop-thread only (mutates the free lists)."""
+        Engine-loop-thread only (mutates the free lists).
+        ``last_reaped`` names the leases this call reclaimed so the
+        caller can close their trace spans (`llmctl trace` shows the
+        reap as the lease's terminal hop)."""
         now = self.clock() if now is None else now
         reclaimed = 0
+        self.last_reaped = []
         for lid in [
             lid for lid, l in self._leases.items() if now >= l.expires_at
         ]:
             lease = self._leases.pop(lid)
             self.release_sequence(lease.page_ids)
             reclaimed += len(lease.page_ids)
+            self.last_reaped.append((lid, len(lease.page_ids)))
         self.lease_reclaimed_pages += reclaimed
         return reclaimed
+
+    # ------------------------------------------------- conservation ledger
+    def ledger_check(self) -> list[str]:
+        """Cheap conservation invariant (docs/observability.md "KV
+        conservation auditor"): every page is exactly one of
+        {free, parked, held}, and refcount totals conserve across
+        attach/COW/release/evict/reap. Pure counter arithmetic over
+        already-maintained ints — O(1), no pool scan, no host sync —
+        so the engine loop runs it every iteration. Returns violation
+        descriptions (empty = conserved); ``audit()`` is the on-demand
+        full scan that names the leaking holder."""
+        violations: list[str] = []
+        free, parked = len(self._free), len(self._reclaimable)
+        held = self._held_pages
+        if free + parked + held != self.num_pages:
+            violations.append(
+                f"page conservation broken: free={free} parked={parked} "
+                f"held={held} sum={free + parked + held} != "
+                f"pool={self.num_pages}"
+            )
+        if not 0 <= self.live_shared <= held:
+            violations.append(
+                f"shared-page count out of range: live_shared="
+                f"{self.live_shared} held={held}"
+            )
+        # Every held page carries >= 1 ref; every shared page >= 2.
+        if self._ref_total < held + self.live_shared:
+            violations.append(
+                f"refcount total below holder floor: ref_total="
+                f"{self._ref_total} < held={held} + shared="
+                f"{self.live_shared}"
+            )
+        if self._ref_total < 0 or held < 0:
+            violations.append(
+                f"negative ledger counter: ref_total={self._ref_total} "
+                f"held={held}"
+            )
+        lease_pins = sum(len(l.page_ids) for l in self._leases.values())
+        if lease_pins > self._ref_total:
+            violations.append(
+                f"lease pins exceed refcount total: lease_pins="
+                f"{lease_pins} ref_total={self._ref_total}"
+            )
+        return violations
+
+    def audit(self, holders: dict[str, Sequence[int]] | None = None) -> dict:
+        """Full on-demand conservation audit (``llmctl audit``): scan
+        the pool, classify every page into exactly one state, and — when
+        ``holders`` maps holder names (``seq:<request_id>``) to the page
+        ids they believe they hold — cross-check per-page refcounts
+        against the holder set so a leak is *named*, not just counted.
+        Leases are joined in automatically as ``lease:<id>`` holders.
+        Read-only; safe (best-effort) from a non-loop thread for flight
+        dumps."""
+        free_list = list(self._free)
+        free = set(free_list)
+        parked = set(self._reclaimable)
+        expected: dict[int, list[str]] = {}
+        all_holders: dict[str, Sequence[int]] = dict(holders or {})
+        for lid, lease in self._leases.items():
+            all_holders[f"lease:{lid}"] = lease.page_ids
+        for name, pids in all_holders.items():
+            for pid in pids:
+                expected.setdefault(pid, []).append(name)
+        counts = {"free": 0, "parked": 0, "active": 0, "shared": 0,
+                  "leased": sum(len(l.page_ids) for l in self._leases.values())}
+        violations: list[dict] = []
+
+        def flag(pid: int, kind: str, detail: str) -> None:
+            violations.append(
+                {
+                    "page": pid,
+                    "kind": kind,
+                    "detail": detail,
+                    "holders": sorted(expected.get(pid, [])),
+                }
+            )
+
+        if len(free) != len(free_list):
+            dupes = sorted(
+                pid for pid in free if free_list.count(pid) > 1
+            )
+            for pid in dupes:
+                flag(pid, "double_release", "page appears twice in the free list")
+        for pid, rec in self._records.items():
+            states = []
+            if pid in free:
+                states.append("free")
+            if pid in parked:
+                states.append("parked")
+            if rec.ref_count > 0:
+                states.append("active")
+            if len(states) != 1:
+                flag(
+                    pid, "state_overlap" if states else "unaccounted",
+                    f"page in states {states or ['none']} "
+                    f"(ref_count={rec.ref_count})",
+                )
+            if rec.ref_count > 0:
+                counts["active"] += 1
+                if rec.ref_count >= 2:
+                    counts["shared"] += 1
+            elif pid in parked:
+                counts["parked"] += 1
+            elif pid in free:
+                counts["free"] += 1
+            if rec.ref_count < 0:
+                flag(pid, "negative_refcount", f"ref_count={rec.ref_count}")
+            want = len(expected.get(pid, []))
+            if all_holders and rec.ref_count != want and (
+                rec.ref_count > 0 or want > 0
+            ):
+                kind = "leaked_ref" if rec.ref_count > want else "lost_ref"
+                flag(
+                    pid, kind,
+                    f"ref_count={rec.ref_count} but {want} live holder(s)",
+                )
+        for check in self.ledger_check():
+            violations.append(
+                {"page": None, "kind": "counter", "detail": check,
+                 "holders": []}
+            )
+        return {
+            "ok": not violations,
+            "counts": counts,
+            "pool": self.num_pages,
+            "leases": len(self._leases),
+            "held_pages": self._held_pages,
+            "ref_total": self._ref_total,
+            "violations": violations,
+        }
 
     # -------------------------------------------------------------- internal
     def _available_for_take(self) -> int:
@@ -584,7 +736,9 @@ class KvPageManager:
         rec = self._records[pid]
         if rec.ref_count == 0:
             self._reclaimable.pop(pid, None)
+            self._held_pages += 1
         rec.ref_count += 1
+        self._ref_total += 1
         if rec.ref_count == 2:
             self.live_shared += 1
             if self.live_shared > self.peak_shared_pages:
@@ -600,6 +754,8 @@ class KvPageManager:
             self._evict(pid)
         rec = self._records[pid]
         rec.ref_count = 1
+        self._held_pages += 1
+        self._ref_total += 1
         rec.seq_hash = None
         rec.filled = True
         rec.filler = ""

@@ -212,6 +212,52 @@ def build_parser() -> argparse.ArgumentParser:
         help="list the file's dump blocks instead of rendering one",
     )
 
+    # Live fleet dashboard (docs/observability.md "Fleet plane"):
+    # scrape every discovered instance's stats plane into one rolled-up
+    # view — per-instance occupancy, queue depth, shed/preempt rates,
+    # per-link transfer MB/s — tolerant of dead/draining members.
+    top = sub.add_parser(
+        "top", help="live fleet dashboard (per-instance + per-link rollup)"
+    )
+    top.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh interval in seconds",
+    )
+    top.add_argument(
+        "--once", action="store_true",
+        help="print one snapshot and exit (no refresh loop)",
+    )
+
+    # Offline KV conservation audit rendering (docs/observability.md
+    # "KV conservation auditor"): flight dumps carry the full named
+    # audit (every page classified, refcounts cross-checked against
+    # live sequences/leases); render the verdict and name the leaker.
+    audit = sub.add_parser(
+        "audit", help="render the KV conservation audit from a flight dump"
+    )
+    audit.add_argument("dump_file", help="flight dump JSONL path")
+    audit.add_argument(
+        "--index", type=int, default=-1,
+        help="which dump block to audit (default: the last)",
+    )
+
+    # Offline bench regression comparator (docs/observability.md "Fleet
+    # plane"): compare two bench captures (raw bench.py JSONL or the
+    # checked-in BENCH_r*.json wrappers) and flag >threshold tok/s or
+    # TTFT/ITL regressions per metric, platform-tag aware. The
+    # pre-merge CI step runs it over the checked-in trajectory.
+    bench = sub.add_parser(
+        "bench", help="bench trajectory tools (offline)"
+    )
+    bsub = bench.add_subparsers(dest="command", required=True)
+    bcmp = bsub.add_parser("compare")
+    bcmp.add_argument("old_file", help="baseline bench capture")
+    bcmp.add_argument("new_file", help="candidate bench capture")
+    bcmp.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="regression threshold as a fraction (default 0.10 = 10%%)",
+    )
+
     # Offline static analysis (docs/static_analysis.md): run the
     # dynlint AST invariant checkers (host-sync / determinism /
     # thread-ownership / recompile-hazard) over the package tree.
@@ -547,6 +593,102 @@ async def run_aot(args) -> int:
     return 0
 
 
+def run_audit(args) -> int:
+    """Render the KV conservation audit carried by a flight dump's
+    snapshot: the per-state page counts, the verdict, and — on a
+    violation — the leaking page with the holder(s) that still claim
+    it (``seq:<request_id>`` / ``lease:<id>``)."""
+    import os
+
+    from .telemetry import load_dumps
+
+    if not os.path.exists(args.dump_file):
+        print(f"no such dump file: {args.dump_file}", file=sys.stderr)
+        return 2
+    blocks = load_dumps(args.dump_file)
+    if not blocks:
+        print("no flight dumps in file", file=sys.stderr)
+        return 1
+    try:
+        block = blocks[args.index]
+    except IndexError:
+        print(
+            f"dump index {args.index} out of range ({len(blocks)} blocks)",
+            file=sys.stderr,
+        )
+        return 1
+    header = block.get("header", {})
+    audit = (block.get("snapshot") or {}).get("kv_audit")
+    if not isinstance(audit, dict):
+        print(
+            "dump carries no kv_audit snapshot (engine predates the "
+            "conservation auditor, or the snapshot failed)",
+            file=sys.stderr,
+        )
+        return 1
+    counts = audit.get("counts", {})
+    print(
+        f"kv audit — reason={header.get('reason', '?')} "
+        f"pool={audit.get('pool', '?')} leases={audit.get('leases', 0)}"
+    )
+    print(
+        "  "
+        + "  ".join(f"{k}={counts.get(k, 0)}" for k in sorted(counts))
+        + f"  held={audit.get('held_pages', '?')}"
+        f"  ref_total={audit.get('ref_total', '?')}"
+    )
+    violations = audit.get("violations", [])
+    if not violations:
+        print("  CONSERVED: every page accounted for, refcounts balance")
+        return 0
+    print(f"  {len(violations)} VIOLATION(S):")
+    for v in violations:
+        page = v.get("page")
+        where = f"page {page}" if page is not None else "counters"
+        holders = ", ".join(v.get("holders") or []) or "no live holder"
+        print(f"    {where}: {v.get('kind')} — {v.get('detail')} [{holders}]")
+    return 1
+
+
+def run_bench_compare(args) -> int:
+    import os
+
+    from .telemetry.bench_compare import (
+        compare_bench,
+        load_bench_lines,
+        render_compare,
+    )
+
+    for path in (args.old_file, args.new_file):
+        if not os.path.exists(path):
+            print(f"no such bench file: {path}", file=sys.stderr)
+            return 2
+    report = compare_bench(
+        load_bench_lines(args.old_file),
+        load_bench_lines(args.new_file),
+        threshold=args.threshold,
+    )
+    print(render_compare(report, args.old_file, args.new_file))
+    return 0 if report.ok else 1
+
+
+async def run_top(drt, args) -> int:
+    """Live fleet dashboard: scrape + render on an interval (`--once`
+    prints a single snapshot for scripts and tests)."""
+    from .telemetry.fleet import FleetAggregator, render_top
+
+    while True:
+        view = await FleetAggregator.scrape_runtime(drt)
+        body = render_top(view)
+        if args.once:
+            print(body)
+            return 0
+        # Cursor-home clear keeps the refresh loop flicker-free on a
+        # bare terminal without a curses dependency.
+        print("\x1b[2J\x1b[H" + body, flush=True)
+        await asyncio.sleep(max(args.interval, 0.2))
+
+
 def run_sim(args) -> int:
     from .planner import PlannerConfig, SloTargets
     from .sim import (
@@ -708,6 +850,10 @@ async def run(args) -> int:
         return run_trace(args)
     if args.plane == "flight":  # offline: reads flight dumps, no cluster
         return run_flight(args)
+    if args.plane == "audit":  # offline: reads flight dumps, no cluster
+        return run_audit(args)
+    if args.plane == "bench":  # offline: reads bench captures, no cluster
+        return run_bench_compare(args)
     if args.plane == "sim":  # offline: modeled fleet, no cluster
         return run_sim(args)
     if args.plane == "aot":  # offline: compile lattice, no cluster
@@ -723,6 +869,8 @@ async def run(args) -> int:
         config=RuntimeConfig(coordinator_endpoint=args.coordinator)
     )
     try:
+        if args.plane == "top":
+            return await run_top(drt, args)
         if args.plane == "drain":
             return await drain_instance(drt, args)
         if args.plane == "disagg":

@@ -155,7 +155,7 @@ class _SimInstance:
     __slots__ = (
         "id", "cfg", "waiting", "bound", "stall_queue", "pages_free",
         "metrics", "draining", "prefix_index", "shared_refs", "parked",
-        "born_at",
+        "born_at", "preemptions",
     )
 
     def __init__(self, iid: int, cfg: SimConfig, now: float):
@@ -167,6 +167,7 @@ class _SimInstance:
         self.pages_free = cfg.pages_per_instance
         self.draining = False
         self.born_at = now
+        self.preemptions = 0  # per-instance share of report.preemptions
         # Prefix sharing (docs/prefix_sharing.md): the SAME radix index
         # the live page manager matches against, over synthetic per-
         # group block chains; refcounts per resident block, plus the
@@ -689,6 +690,7 @@ class ClusterSim:
             inst.stall_queue.remove(victim)
         victim.state = SeqState.WAITING
         inst.waiting.append(victim)  # back of the queue, like the engine
+        inst.preemptions += 1
         self.report.preemptions += 1
         self._log(
             "req %d preempted on inst %d (%d tokens into the round)",
@@ -878,4 +880,21 @@ class ClusterSim:
         r.ttft_p99_s = percentile(self._ttfts, 0.99)
         r.itl_p50_s = percentile(self._itls, 0.5)
         r.itl_p99_s = percentile(self._itls, 0.99)
+        # Fleet rollup through the SAME FleetView code path the live
+        # FleetAggregator uses (docs/observability.md "Fleet plane"), so
+        # fleet numbers are comparable live<->sim by construction. Keyed
+        # sim-<id> in sorted order; rollup is deterministic (the view's
+        # wall-clock scrape stamp never enters it).
+        from ..telemetry.fleet import FleetView
+
+        r.fleet = FleetView.from_snapshots(
+            {
+                f"sim-{iid}": {
+                    **inst.refresh_metrics().to_dict(),
+                    "preemptions": inst.preemptions,
+                    "draining": inst.draining,
+                }
+                for iid, inst in self.instances.items()
+            }
+        ).rollup()
         return r

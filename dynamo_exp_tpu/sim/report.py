@@ -66,6 +66,11 @@ class SimReport:
     events: int = 0
     wall_clock_s: float = 0.0
     planner_actions: list[dict] = field(default_factory=list)
+    # Fleet rollup at drain time, built through the SAME
+    # telemetry.fleet.FleetView path the live FleetAggregator uses
+    # (docs/observability.md "Fleet plane") — per-instance occupancy /
+    # queue depth / preemptions rolled up identically live and sim.
+    fleet: dict = field(default_factory=dict)
 
     @property
     def shed(self) -> int:

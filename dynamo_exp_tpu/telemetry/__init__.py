@@ -19,6 +19,15 @@ from .context import (
     wire_headers,
 )
 from .dispatch import DISPATCH_KINDS, DispatchProfiler
+from .fleet import (
+    FleetAggregator,
+    FleetView,
+    InstanceView,
+    TransferLedger,
+    get_transfer_ledger,
+    parse_prometheus_text,
+    render_top,
+)
 from .flight import (
     FlightRecorder,
     Watchdog,
@@ -28,17 +37,27 @@ from .flight import (
 )
 from .slo import SloAttribution, SloConfig, percentile
 from .spans import Span, Telemetry, adopt, get_telemetry, span
-from .timeline import find_trace, list_traces, load_spans, render_timeline
+from .timeline import (
+    find_trace,
+    list_traces,
+    load_spans,
+    render_timeline,
+    transfer_hops,
+)
 
 __all__ = [
     "DISPATCH_KINDS",
     "DispatchProfiler",
+    "FleetAggregator",
+    "FleetView",
     "FlightRecorder",
+    "InstanceView",
     "SloAttribution",
     "SloConfig",
     "Span",
     "Telemetry",
     "TraceContext",
+    "TransferLedger",
     "Watchdog",
     "adopt",
     "attach",
@@ -49,13 +68,17 @@ __all__ = [
     "dump_all",
     "find_trace",
     "get_telemetry",
+    "get_transfer_ledger",
     "list_traces",
     "load_dumps",
     "load_spans",
     "new_trace",
+    "parse_prometheus_text",
     "percentile",
     "render_flight",
     "render_timeline",
+    "render_top",
     "span",
+    "transfer_hops",
     "wire_headers",
 ]

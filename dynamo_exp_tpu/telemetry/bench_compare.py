@@ -1,0 +1,191 @@
+"""Bench regression comparator (``llmctl bench compare A.json B.json``).
+
+Compares two bench captures — raw ``bench.py`` JSONL output or the
+checked-in ``BENCH_r*.json`` wrappers (``{"n", "cmd", "rc", "tail",
+"parsed"}``) — metric by metric, and flags regressions: throughput
+(``tok/s`` lines) dropping more than the threshold, or any latency
+field (``*ttft*``, ``*itl*`` — p50/p99 alike) growing more than the
+threshold.
+
+Platform-tag aware: ``bench.py`` tags every line with the platform it
+actually ran on (the TPU tunnel has been down since r02, so r02+ are
+CPU-fallback lines), and a CPU number is not comparable to a chip
+number — such pairs are reported as skipped, never as regressions.
+Captures with no comparable pairs (e.g. two failed runs) compare clean:
+the pre-merge CI step runs this over the checked-in trajectory, and a
+dead tunnel must not block merges.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+def load_bench_lines(path: str) -> list[dict]:
+    """Every bench metric line in ``path``. Accepts raw bench JSONL
+    (one metric object per line) and the BENCH_r* wrapper shape (metric
+    lines recovered from ``parsed`` + the stdout ``tail``). Unparseable
+    lines are skipped — a crashed run yields [] rather than an error."""
+    with open(path) as f:
+        text = f.read()
+    lines: list[dict] = []
+    seen: set[str] = set()
+
+    def add(obj) -> None:
+        if isinstance(obj, dict) and obj.get("metric"):
+            key = json.dumps(obj, sort_keys=True)
+            if key not in seen:
+                seen.add(key)
+                lines.append(obj)
+
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and ("tail" in doc or "parsed" in doc):
+        add(doc.get("parsed"))
+        for raw in str(doc.get("tail", "")).splitlines():
+            raw = raw.strip()
+            if raw.startswith("{"):
+                try:
+                    add(json.loads(raw))
+                except ValueError:
+                    continue
+        return lines
+    if isinstance(doc, dict):
+        add(doc)
+        return lines
+    if isinstance(doc, list):
+        for obj in doc:
+            add(obj)
+        return lines
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if raw.startswith("{"):
+            try:
+                add(json.loads(raw))
+            except ValueError:
+                continue
+    return lines
+
+
+_LATENCY_MARKERS = ("ttft", "itl", "latency")
+
+
+def _latency_fields(line: dict) -> dict[str, float]:
+    out = {}
+    for key, val in line.items():
+        if not isinstance(val, (int, float)):
+            continue
+        if any(m in key for m in _LATENCY_MARKERS) and key.endswith("_s"):
+            out[key] = float(val)
+    return out
+
+
+@dataclass
+class Finding:
+    metric: str
+    field: str
+    old: float
+    new: float
+    change: float  # signed fraction (+ = grew)
+    kind: str  # "regression" | "improvement" | "skipped"
+    note: str = ""
+
+
+@dataclass
+class CompareReport:
+    findings: list[Finding] = field(default_factory=list)
+    compared: int = 0
+    skipped: list[str] = field(default_factory=list)
+
+    @property
+    def regressions(self) -> list[Finding]:
+        return [f for f in self.findings if f.kind == "regression"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+
+def compare_bench(
+    old_lines: list[dict],
+    new_lines: list[dict],
+    threshold: float = 0.10,
+) -> CompareReport:
+    """Flag per-metric regressions beyond ``threshold`` (default 10%).
+
+    Only metrics present in BOTH captures compare; a platform-tag
+    mismatch (chip vs CPU-fallback line) skips the pair with a note.
+    Throughput compares on ``value`` for ``tok/s`` lines (lower = worse);
+    every ``*ttft*``/``*itl*`` latency field compares too (higher =
+    worse)."""
+    report = CompareReport()
+    by_metric = {ln["metric"]: ln for ln in old_lines}
+    for new in new_lines:
+        old = by_metric.get(new["metric"])
+        if old is None:
+            continue
+        p_old = old.get("platform")
+        p_new = new.get("platform")
+        if p_old != p_new:
+            report.skipped.append(
+                f"{new['metric']}: platform {p_old or 'untagged'} vs "
+                f"{p_new or 'untagged'} — not comparable"
+            )
+            continue
+        report.compared += 1
+
+        def judge(fld: str, a: float, b: float, higher_is_worse: bool,
+                  metric: str = new["metric"]) -> None:
+            if a <= 0:
+                return
+            change = (b - a) / a
+            worse = change > threshold if higher_is_worse else (
+                change < -threshold
+            )
+            better = change < -threshold if higher_is_worse else (
+                change > threshold
+            )
+            kind = (
+                "regression" if worse else "improvement" if better else None
+            )
+            if kind:
+                report.findings.append(
+                    Finding(metric, fld, a, b, round(change, 4), kind)
+                )
+
+        if old.get("unit") == "tok/s" and isinstance(
+            new.get("value"), (int, float)
+        ) and isinstance(old.get("value"), (int, float)):
+            judge("value(tok/s)", float(old["value"]), float(new["value"]),
+                  higher_is_worse=False)
+        lat_old, lat_new = _latency_fields(old), _latency_fields(new)
+        for fld in sorted(set(lat_old) & set(lat_new)):
+            judge(fld, lat_old[fld], lat_new[fld], higher_is_worse=True)
+    return report
+
+
+def render_compare(report: CompareReport, a: str, b: str) -> str:
+    lines = [
+        f"bench compare: {a} -> {b}  "
+        f"({report.compared} comparable metric(s), "
+        f"{len(report.skipped)} skipped)"
+    ]
+    for f in report.findings:
+        arrow = "REGRESSION" if f.kind == "regression" else "improvement"
+        lines.append(
+            f"  {arrow}: {f.metric} {f.field} "
+            f"{f.old:g} -> {f.new:g} ({f.change:+.1%})"
+        )
+    for note in report.skipped:
+        lines.append(f"  skipped: {note}")
+    if report.compared == 0:
+        lines.append(
+            "  no comparable metrics (failed runs or disjoint modes) — "
+            "nothing to flag"
+        )
+    elif report.ok:
+        lines.append("  no regressions beyond threshold")
+    return "\n".join(lines)

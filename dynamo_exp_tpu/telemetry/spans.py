@@ -23,6 +23,7 @@ import atexit
 import contextlib
 import logging
 import os
+import socket
 import threading
 import time
 from dataclasses import dataclass, field
@@ -115,6 +116,16 @@ class Telemetry:
         self.registry = registry or CollectorRegistry()
         self._recorder = None
         self._rec_lock = threading.Lock()
+        # Instance identity (docs/observability.md "Fleet plane"):
+        # stamped on every emitted span so a disagg request's stitched
+        # trace shows *which* instance ran each hop, and carried on the
+        # KV transfer wire for the per-link ledger. ``DYN_INSTANCE``
+        # names it explicitly (deployments set it per pod); the
+        # host:pid default keeps multi-process dev graphs distinct.
+        self.instance = (
+            os.environ.get("DYN_INSTANCE", "").strip()
+            or f"{socket.gethostname()}:{os.getpid()}"
+        )
         self.stage_duration = Histogram(
             "dynamo_stage_duration_seconds",
             "Per-stage request latency (one series per pipeline stage)",
@@ -374,6 +385,48 @@ class Telemetry:
             ["priority"],
             registry=self.registry,
         )
+        # Fleet observability plane (docs/observability.md "Fleet
+        # plane"): the KV conservation auditor's violation counter (0 in
+        # any healthy run — a nonzero value names a page-accounting bug,
+        # with the full audit in the flight dump it triggers), the
+        # per-link KV transfer ledger mirrors, and the build-info
+        # config-skew fingerprint.
+        self.kv_ledger_violations = Counter(
+            "dynamo_kv_ledger_violations_total",
+            "KV page-ledger conservation violations detected by the "
+            "in-loop auditor (every page exactly one of free/parked/"
+            "active/leased/shared, refcount totals conserved)",
+            registry=self.registry,
+        )
+        self.kv_link_bytes = Counter(
+            "dynamo_kv_link_bytes_total",
+            "KV lease-transfer payload bytes per (src, dst) instance "
+            "link, as observed by this process's transfer ledger",
+            ["src", "dst"],
+            registry=self.registry,
+        )
+        self.kv_link_transfers = Counter(
+            "dynamo_kv_link_transfers_total",
+            "KV lease transfers observed per (src, dst) instance link",
+            ["src", "dst"],
+            registry=self.registry,
+        )
+        self.kv_link_bandwidth = Gauge(
+            "dynamo_kv_link_bandwidth_bytes_per_s",
+            "Online per-link bandwidth estimate (EWMA over observed "
+            "extract->ack lease-transfer durations) — the input surface "
+            "for topology-aware decode-instance selection",
+            ["src", "dst"],
+            registry=self.registry,
+        )
+        self.build_info = Gauge(
+            "dynamo_build_info",
+            "Constant-1 config-skew fingerprint: AOT lattice manifest "
+            "hash, jax version, and serving feature flags — fleet "
+            "scrapes compare label sets across instances",
+            ["manifest_hash", "jax_version", "prefix_sharing", "spec"],
+            registry=self.registry,
+        )
 
     # ------------------------------------------------------------ recorder
     def configure(self, trace_file: str | None) -> None:
@@ -440,7 +493,10 @@ class Telemetry:
     # ------------------------------------------------------------ emission
     def emit(self, span: Span) -> None:
         """Record one finished span (thread-safe; never raises into the
-        serving path)."""
+        serving path). Every span is stamped with this process's
+        instance identity so a cross-instance trace renders as a
+        multi-instance timeline (docs/observability.md "Fleet plane")."""
+        span.attrs.setdefault("instance", self.instance)
         self.stage_duration.labels(span.stage).observe(span.duration_s)
         rec = self._recorder
         if rec is not None:
@@ -477,6 +533,24 @@ class Telemetry:
         )
 
     # -------------------------------------------------------------- gauges
+    def set_build_info(
+        self,
+        manifest_hash: str = "",
+        jax_version: str = "",
+        prefix_sharing: bool = False,
+        spec: str = "off",
+    ) -> None:
+        """Publish the constant-1 ``dynamo_build_info`` sample (clearing
+        any previous label set, so one instance never exports two
+        fingerprints after a live reconfigure)."""
+        self.build_info.clear()
+        self.build_info.labels(
+            manifest_hash or "unknown",
+            jax_version or "unknown",
+            str(bool(prefix_sharing)).lower(),
+            spec or "off",
+        ).set(1)
+
     def publish_engine_gauges(self, metrics: dict) -> None:
         """Mirror an engine ``metrics()`` dict into the engine gauges
         (unknown keys ignored, so callers can pass the full dict)."""

@@ -100,6 +100,12 @@ def render_timeline(spans: list[Span], width: int = 40) -> str:
         http_request          0.0ms  132.8ms |##############################|
           preprocess          0.3ms    1.9ms |=                             |
           ...
+
+    A trace whose spans come from more than one instance (a stitched
+    disagg/failover trace) renders as a multi-instance timeline: each
+    span's instance shows in its own column, and the cross-instance KV
+    transfer hops are summarized (per-hop duration, bytes, MB/s) after
+    the tree — the traced view of what the TransferLedger aggregates.
     """
     if not spans:
         return "no spans"
@@ -112,9 +118,16 @@ def render_timeline(spans: list[Span], width: int = 40) -> str:
         (s.attrs["request_id"] for s, _ in ordered if "request_id" in s.attrs),
         None,
     )
+    instances = sorted(
+        {str(s.attrs["instance"]) for s in spans if s.attrs.get("instance")}
+    )
+    multi = len(instances) > 1
+    inst_w = max((len(i) for i in instances), default=0) if multi else 0
     head = f"trace {spans[0].trace_id} — {len(spans)} spans, {total * 1e3:.1f}ms"
     if req:
         head += f" (request {req})"
+    if multi:
+        head += f" across {len(instances)} instances"
     lines = [head]
     for s, depth in ordered:
         off = s.start - t0
@@ -124,14 +137,56 @@ def render_timeline(spans: list[Span], width: int = 40) -> str:
         bar = " " * min(left, width - 1) + "#" * fill
         bar = bar[:width].ljust(width)
         label = ("  " * depth + s.stage).ljust(name_w)
-        lines.append(
-            f"{label}  {off * 1e3:8.1f}ms {s.duration_s * 1e3:9.1f}ms |{bar}|"
+        inst = (
+            f" [{str(s.attrs.get('instance', '?')):<{inst_w}}]" if multi else ""
         )
-        extra = {k: v for k, v in s.attrs.items() if k != "request_id"}
+        lines.append(
+            f"{label}{inst}  {off * 1e3:8.1f}ms {s.duration_s * 1e3:9.1f}ms "
+            f"|{bar}|"
+        )
+        extra = {
+            k: v
+            for k, v in s.attrs.items()
+            if k not in ("request_id", "instance")
+        }
         if extra:
             kv = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
             lines.append(" " * (name_w + 2) + f"  {kv}")
+    hops = transfer_hops(spans)
+    if hops:
+        lines.append("transfer hops:")
+        for h in hops:
+            mbs = (
+                f", {h['bytes'] / max(h['duration_s'], 1e-9) / (1 << 20):.1f}"
+                " MB/s"
+                if h["bytes"]
+                else ""
+            )
+            lines.append(
+                f"  {h['stage']}: {h['src']} -> {h['dst']}  "
+                f"{h['duration_s'] * 1e3:.1f}ms, {h['bytes']} bytes{mbs}"
+            )
     return "\n".join(lines)
+
+
+def transfer_hops(spans: list[Span]) -> list[dict]:
+    """The trace's KV transfer hops (send/recv spans with their link
+    endpoints), start-ordered — the per-trace view the TransferLedger's
+    per-link bandwidth estimates must be consistent with."""
+    hops = []
+    for s in sorted(spans, key=lambda x: x.start):
+        if s.stage not in ("kv_transfer_send", "kv_transfer_recv"):
+            continue
+        hops.append(
+            {
+                "stage": s.stage,
+                "src": str(s.attrs.get("src", s.attrs.get("instance", "?"))),
+                "dst": str(s.attrs.get("dst", "?")),
+                "bytes": int(s.attrs.get("bytes", 0) or 0),
+                "duration_s": s.duration_s,
+            }
+        )
+    return hops
 
 
 def list_traces(spans: list[Span]) -> list[tuple[str, int, float, str]]:

@@ -8,3 +8,30 @@ from .fixtures import build_tiny_model_dir
 @pytest.fixture(scope="session")
 def tiny_model_dir(tmp_path_factory) -> str:
     return build_tiny_model_dir(str(tmp_path_factory.mktemp("tiny-model")))
+
+
+@pytest.fixture(autouse=True)
+def _kv_ledger_guard(request):
+    """KV conservation auditor as a suite-wide invariant
+    (docs/observability.md "KV conservation auditor"): every in-process
+    engine's in-loop check and stop()-time audit append violations to a
+    process-wide registry; this guard asserts the registry did not grow
+    during the test — so the chaos/overload/prefix-sharing/resumable
+    state machines are conservation-checked continuously, not just by
+    the dedicated ledger suite. Tests that inject a leak on purpose
+    mark themselves ``ledger_leak`` (the guard then expects growth and
+    truncates the registry for the next test)."""
+    from dynamo_exp_tpu.engine.engine import LEDGER_VIOLATIONS
+
+    before = len(LEDGER_VIOLATIONS)
+    yield
+    grew = LEDGER_VIOLATIONS[before:]
+    if request.node.get_closest_marker("ledger_leak"):
+        del LEDGER_VIOLATIONS[before:]
+        assert grew, (
+            "test is marked ledger_leak but the auditor saw no violation"
+        )
+        return
+    assert not grew, (
+        f"KV ledger violations during this test: {grew}"
+    )

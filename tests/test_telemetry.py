@@ -81,7 +81,9 @@ def test_span_records_to_recorder(tmp_path):
             pass
         spans = load_spans([path])
         assert [s.stage for s in spans] == ["solo"]
-        assert spans[0].attrs == {"foo": 1}
+        # Every span carries the process's instance identity (the fleet
+        # plane's multi-instance stitching, docs/observability.md).
+        assert spans[0].attrs == {"foo": 1, "instance": tel.instance}
         assert spans[0].duration_s >= 0
     finally:
         tel.configure(None)
@@ -210,9 +212,12 @@ async def test_disagg_request_produces_connected_trace(tmp_path, tiny_model_dir)
     from dynamo_exp_tpu.http import HttpService, build_pipeline_engine
     from dynamo_exp_tpu.model_card import ModelDeploymentCard
 
+    from dynamo_exp_tpu.telemetry import get_transfer_ledger
+
     tel = get_telemetry()
     trace_file = str(tmp_path / "trace.jsonl")
     tel.configure(trace_file)
+    get_transfer_ledger().reset()
 
     prefill_eng, decode_eng = make_engine(), make_engine()
     queue = InProcWorkQueue()
@@ -286,9 +291,32 @@ async def test_disagg_request_produces_connected_trace(tmp_path, tiny_model_dir)
     expected = {
         "http_request", "preprocess", "remote_prefill", "queue_wait",
         "prefill", "kv_transfer_send", "kv_transfer_recv", "decode",
+        # The handoff lease's grant -> confirm hop (fleet plane,
+        # docs/observability.md "Fleet plane").
+        "kv_lease",
     }
     assert expected <= stages
     assert len(spans) >= 5
+
+    # Fleet-plane acceptance: the trace's transfer hops carry the link
+    # endpoints, and the TransferLedger's per-link bandwidth estimate is
+    # consistent with the traced extract->ack durations.
+    from dynamo_exp_tpu.telemetry import transfer_hops
+
+    hops = transfer_hops(spans)
+    assert hops, "no transfer hops in the stitched trace"
+    for hop in hops:
+        assert hop["src"] == tel.instance  # in-proc graph: one identity
+        assert hop["bytes"] > 0 and hop["duration_s"] > 0
+    lease_spans = [s for s in spans if s.stage == "kv_lease"]
+    assert lease_spans and lease_spans[0].attrs["outcome"] == "confirmed"
+    led = get_transfer_ledger()
+    rates = [h["bytes"] / h["duration_s"] for h in hops]
+    for hop in hops:
+        bw = led.bandwidth_bps(hop["src"], hop["dst"])
+        assert bw is not None
+        # EWMA over the traced observations stays inside their range.
+        assert min(rates) * 0.5 <= bw <= max(rates) * 2.0
 
     # Every non-root span parents into the tree (single connected trace).
     ids = {s.span_id for s in spans}
@@ -563,3 +591,40 @@ def test_every_engine_metrics_mirror_key_is_documented():
         assert not undocumented_fields, undocumented_fields
     finally:
         engine.stop()
+
+
+def test_fleet_plane_surface_is_documented():
+    """Doc-sync guard (fleet-plane extension): the fleet-level rollup
+    keys, the per-link ledger fields, and the new operator commands
+    (`llmctl top` / `llmctl audit` / `llmctl bench compare`) must land
+    in docs/observability.md's "Fleet plane" section, with matching
+    suite rows in docs/testing.md and the README pointer — the same
+    discipline as the metric-name guard above."""
+    from dynamo_exp_tpu.telemetry.fleet import FleetView, LinkStats
+
+    doc = _observability_doc()
+    assert "## Fleet plane" in doc
+    assert "## KV conservation auditor" in doc
+    for cmd in ("llmctl top", "llmctl audit", "llmctl bench compare"):
+        assert cmd in doc, f"{cmd!r} undocumented in docs/observability.md"
+    # Every fleet rollup key and ledger link field is contract surface
+    # (llmctl top, SimReport.fleet, and the planner consume them).
+    rollup = FleetView.from_snapshots({}).rollup()
+    missing = [k for k in rollup if f"`{k}`" not in doc and k not in doc]
+    assert not missing, (
+        f"fleet rollup keys undocumented in docs/observability.md: {missing}"
+    )
+    link = LinkStats("a", "b").to_dict()
+    missing_link = [k for k in link if f"`{k}`" not in doc and k not in doc]
+    assert not missing_link, missing_link
+
+    import os
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "..", "docs", "testing.md")) as f:
+        testing = f.read()
+    for row in ("test_fleet.py", "test_kv_ledger.py", "llmctl audit"):
+        assert row in testing, f"{row!r} missing from docs/testing.md"
+    with open(os.path.join(here, "..", "README.md")) as f:
+        readme = f.read()
+    assert "Fleet plane" in readme
