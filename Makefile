@@ -22,6 +22,10 @@ SIM_SEED_SETS := 7,21,1337 3,9,27
 # speculation force-enabled via the DYN_SPEC env toggle — every stream
 # must stay token-identical with spec on (docs/speculative.md).
 SPEC_SEED_SETS := 7,21,1337
+# Predictive KV tiering seed sets: the 8x-pool aggregate-context
+# identity sweep (proactive offload + prefetch under pressure,
+# conservation-audited) in tests/test_kv_tiering.py.
+TIERING_SEED_SETS := 7,21,1337 3,9,27
 
 .PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare
 
@@ -58,6 +62,10 @@ chaos:
 	for seeds in $(CHAOS_SEED_SETS); do \
 		echo "=== KV conservation ledger suite, CHAOS_SEEDS=$$seeds ==="; \
 		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_kv_ledger.py -q -m chaos; \
+	done; \
+	for seeds in $(TIERING_SEED_SETS); do \
+		echo "=== predictive KV tiering sweep, CHAOS_SEEDS=$$seeds ==="; \
+		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_kv_tiering.py -q -m chaos; \
 	done
 
 # Seeded simulator regression sets (mirrors `make chaos`): every seed

@@ -55,6 +55,9 @@ CPU_SWEEP_ISL = 256
 CPU_SWEEP_OSL = 32
 CPU_SWEEP_CONCURRENCY = (1, 2, 4)
 CPU_SWEEP_KW = dict(slots=4, isl=128, osl=32)  # occupancy/overload sweeps
+# Offload-pressure axis CPU trim (occupancy sweep only — the shared
+# CPU_SWEEP_KW also feeds run_overload_sweep, which has no such axis).
+CPU_PRESSURE_MULTIPLES = (1, 2, 4)
 CPU_OVERLOAD_BURSTS = (4, 8, 16)
 CPU_PREFIX_KW = dict(isl=256, osl=8, concurrency=4)
 # Prefix-sharing sweep CPU fallback: same trim treatment — tiny shapes,
@@ -251,7 +254,10 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
 
 
 def run_occupancy_sweep(
-    slots: int = 8, isl: int = 512, osl: int = 128
+    slots: int = 8,
+    isl: int = 512,
+    osl: int = 128,
+    pressure_multiples: tuple = (1, 2, 4, 8),
 ) -> list[dict]:
     """Decode throughput vs *occupancy* on a fixed-slot engine.
 
@@ -442,6 +448,89 @@ def run_occupancy_sweep(
             }
         )
     engine.stop()
+
+    # -------- offload-pressure axis (predictive KV tiering) --------
+    # The ROADMAP's named proof surface: hold the pool fixed and scale
+    # the AGGREGATE context to multiples of it. One line per multiple,
+    # tagged with the tiering counters (prefetch hit rate, proactive
+    # offloads, swap-ins) plus the preemptions and p99 ITL the policy
+    # is supposed to bound — at 8x pool a healthy line shows proactive
+    # offloads absorbing the pressure with preemptions near zero.
+    per_seq_pages = (isl + osl) // 16 + 2
+    pool = max(2 * per_seq_pages, (slots * per_seq_pages) // 2)
+    for mult in pressure_multiples:
+        n_req = max(-(-mult * pool * 16 // (isl + osl)), 1)
+        pcfg = EngineConfig(
+            model=mcfg,
+            max_decode_slots=slots,
+            page_size=16,
+            num_pages=pool,
+            max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
+            eos_token_ids=[],
+            kv_dtype=_kv_dtype(),
+            decode_window=32,
+            host_cache_pages=pool * 8,
+            preempt_stall_grace_s=0.5,
+        )
+        peng = _build_engine(pcfg)
+
+        async def pressure_one(prompt, eng=peng):
+            b = BackendInput(token_ids=prompt)
+            b.stop_conditions.max_tokens = osl
+            b.stop_conditions.ignore_eos = True
+            stream = await eng.generate(b.to_dict())
+            n = 0
+            gaps: list[float] = []
+            last = None
+            async for item in stream:
+                got = len(item.get("token_ids", []))
+                if got:
+                    now_t = time.perf_counter()
+                    if last is not None:
+                        gaps.append((now_t - last) / got)
+                    last = now_t
+                    n += got
+            return n, gaps
+
+        async def pressure_point(n=n_req):
+            batch = [
+                rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
+                for _ in range(n)
+            ]
+            t0 = time.perf_counter()
+            results = await asyncio.gather(*[pressure_one(p) for p in batch])
+            dt = time.perf_counter() - t0
+            total = sum(n for n, _ in results)
+            itls = sorted(g for _, gaps in results for g in gaps)
+            p99 = itls[min(int(len(itls) * 0.99), len(itls) - 1)] if itls else None
+            return total / dt, p99
+
+        tok_s, p99_itl = asyncio.run(pressure_point())
+        m = peng.metrics()
+        restored = m["kv_prefetch_pages"]
+        hit_rate = (
+            round(m["kv_prefetch_hits"] / restored, 4) if restored else None
+        )
+        out.append(
+            {
+                "metric": f"kv_tiering_{MODEL}_isl{isl}_osl{osl}_x{mult}",
+                "value": round(tok_s, 1),
+                "unit": "tok/s",
+                "aggregate_x_pool": mult,
+                "requests": n_req,
+                "pool_pages": pool,
+                "host_pages": pool * 8,
+                "prefetch_restored_pages": restored,
+                "prefetch_hit_rate": hit_rate,
+                "proactive_offloads": m["kv_proactive_offloads"],
+                "swap_ins": m["kv_swap_ins"],
+                "preemptions": m["preemptions"],
+                "p99_itl_s": round(p99_itl, 4) if p99_itl is not None else None,
+                "decode_window": peng.cfg.decode_window,
+                "dispatch": _dispatch_stats(peng),
+            }
+        )
+        peng.stop()
     return out
 
 
@@ -1304,7 +1393,12 @@ def main() -> None:
         for c in CPU_SWEEP_CONCURRENCY if cpu else SWEEP_CONCURRENCY:
             emit(run_point(s_isl, s_osl, c))
     elif args.occupancy_sweep:
-        for point in run_occupancy_sweep(**(CPU_SWEEP_KW if cpu else {})):
+        kw = (
+            dict(CPU_SWEEP_KW, pressure_multiples=CPU_PRESSURE_MULTIPLES)
+            if cpu
+            else {}
+        )
+        for point in run_occupancy_sweep(**kw):
             emit(point)
     elif args.overload_sweep:
         kw = (

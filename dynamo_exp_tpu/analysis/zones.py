@@ -32,6 +32,11 @@ HOT_PATH_ZONES: tuple[Zone, ...] = (
     # register_full_page on the loop thread — pure host bookkeeping,
     # and it must stay that way.
     Zone("dynamo_exp_tpu/kv/prefix.py"),
+    # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+    # tiering"): footprint forecasts, packing selection, and swap
+    # planning all run inside the admission/pressure paths on the loop
+    # thread — pure host bookkeeping, no device value may reach them.
+    Zone("dynamo_exp_tpu/engine/tiering.py"),
     # The profiler's whole contract is "zero added host syncs"
     # (docs/observability.md); the checker turns that claim into a
     # standing property instead of one driven smoke test.
@@ -77,6 +82,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
             "prefill_extract",  # asyncio ingress (disagg prefill)
             "confirm_kv_lease",  # prefill worker's delivery ack thread
             "pin_prefix",  # disagg router's suffix-transfer pin (asyncio)
+            "_on_prefetched",  # CopyStream fetch completion (copy thread)
             "start",
             "stop",
             "metrics",  # /metrics scrapes from serving threads
@@ -118,6 +124,18 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_ledger_last",
                 "_ledger_dumped",
                 "_lease_traces",
+                # Predictive KV tiering (docs/engine_perf.md): prefetch
+                # planning state + counters and the proactive-offload
+                # (swap) counters — all mutated on the loop only; the
+                # copy thread answers through _prefetch_done_q.
+                "_prefetch_inflight",
+                "_prefetch_served",
+                "_last_prefetch_scan",
+                "prefetch_pages",
+                "prefetch_hits",
+                "prefetch_late",
+                "proactive_offloads",
+                "swap_ins",
             }
         ),
         handoff=frozenset(
@@ -126,6 +144,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_submit_q",
                 "_lease_confirm_q",
                 "_pin_q",
+                "_prefetch_done_q",  # copy thread -> loop (fetch results)
                 "_wake",
                 # Lifecycle flags/threads, written only before the loop
                 # starts or after it is joined.

@@ -13,6 +13,15 @@ from dataclasses import dataclass, field
 from ..models.config import ModelConfig
 
 
+def _env_flag(name: str) -> bool | None:
+    """Tri-state env toggle: None when unset/empty, else truthiness
+    (the DYN_SPEC spelling rules: 1/true/on/yes vs 0/false/no/off)."""
+    v = os.environ.get(name, "").strip().lower()
+    if not v:
+        return None
+    return v in ("1", "true", "on", "yes")
+
+
 def default_prefill_buckets(max_len: int) -> list[int]:
     buckets = []
     b = 16
@@ -148,6 +157,42 @@ class EngineConfig:
     # arithmetic — zero added host syncs (sync-spy-proven). Off only
     # for A/B overhead measurement.
     kv_ledger_check: bool = True
+    # ---- Predictive KV tiering (docs/engine_perf.md "Predictive KV
+    # tiering"). Env overrides: DYN_KV_PACKING / DYN_KV_PREFETCH /
+    # DYN_KV_PROACTIVE flip each policy for whole suites without
+    # touching call sites (truthy/falsy spellings like DYN_SPEC).
+    #
+    # Footprint-packed admission: forecast each waiting sequence's
+    # lifetime KV footprint (prompt + max_tokens, minus the
+    # radix-matched resident prefix) and admit the first sequence whose
+    # forecast fits free-page headroom — an oversize head that would
+    # only stall defers behind smaller work. Packing never refuses an
+    # admission first-fit would have made; it only reorders, with
+    # priority-inversion and starvation guards
+    # (engine/tiering.select_packed_index).
+    kv_packing: bool = True
+    packing_scan_limit: int = 16  # waiting-queue prefix scanned per pass
+    packing_max_defers: int = 64  # bypasses before a seq becomes a barrier
+    # G2→G1 prefetch: restore host-resident prefixes of *waiting*
+    # prompts ahead of admission (the CopyStream's device-bound
+    # direction), so restores overlap compute instead of landing inside
+    # the admission path. Active only with a host tier
+    # (host_cache_pages > 0).
+    kv_prefetch: bool = True
+    prefetch_depth: int = 4  # waiting sequences scanned per pass
+    # Headroom (free + parked pages) prefetch never consumes — decode
+    # growth must always win. Prefetch MAY evict parked LRU pages
+    # beyond the reserve: their content writes back to the host tier,
+    # so it trades LRU-cold cache for predicted-hot cache losslessly.
+    prefetch_reserve_pages: int = 4
+    # Proactive cold-tail offload: once a row has been hard-stalled
+    # this long (and before preempt_stall_grace_s expires), swap the
+    # coldest eligible row's refcount-1 non-leased pages out to the
+    # host tier — bytes preserved, resume token-identical — instead of
+    # preempting. Negative disables; requires a host tier. Must be <
+    # preempt_stall_grace_s to fire first (preemption stays the
+    # fallback when swapping can't free enough).
+    proactive_offload_grace_s: float = 0.0
 
     def __post_init__(self):
         if not self.prefill_buckets:
@@ -167,6 +212,20 @@ class EngineConfig:
                 self.spec_mode = "ngram"
             elif low not in ("0", "false", "no", "off"):
                 self.spec_mode = env
+        # Predictive-tiering env toggles (suite-wide A/B without call-
+        # site changes; an explicit falsy spelling turns a policy off).
+        for env_name, attr in (
+            ("DYN_KV_PACKING", "kv_packing"),
+            ("DYN_KV_PREFETCH", "kv_prefetch"),
+        ):
+            flag = _env_flag(env_name)
+            if flag is not None:
+                setattr(self, attr, flag)
+        flag = _env_flag("DYN_KV_PROACTIVE")
+        if flag is not None:
+            self.proactive_offload_grace_s = (
+                max(self.proactive_offload_grace_s, 0.0) if flag else -1.0
+            )
         if self.spec_max_draft < self.spec_min_draft or self.spec_min_draft < 1:
             raise ValueError(
                 f"bad spec draft bounds [{self.spec_min_draft}, "

@@ -46,6 +46,7 @@ import queue
 import random
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
 from typing import AsyncIterator, Callable
@@ -79,6 +80,7 @@ from ..parallel.mesh import build_mesh
 from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from ..telemetry import current_trace, get_telemetry
+from ..tokens import compute_block_hashes_for_seq
 from ..telemetry.dispatch import DispatchProfiler
 from ..telemetry.flight import (
     FlightRecorder,
@@ -91,6 +93,7 @@ from .config import EngineConfig
 from .kv_manager import KvEvent, KvPageManager
 from .offload import CopyStream, HostKvPool
 from .scheduler import RemoteKv, Scheduler, SeqState, Sequence
+from .tiering import SwapRecord, plan_swap_entries
 
 log = logging.getLogger(__name__)
 
@@ -429,6 +432,24 @@ class TPUEngine(AsyncEngine):
         # lease grant -> confirm | reap as one hop of the request's
         # timeline. Loop-owned (grant, confirm, and reap all run here).
         self._lease_traces: dict[str, tuple] = {}
+        # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+        # tiering"). Prefetch: in-flight G2→G1 jobs by target request
+        # (loop-owned; the copy thread answers through
+        # _prefetch_done_q), completed restores by target for hit
+        # attribution at admission (bounded), and the scan throttle.
+        self._prefetch_inflight: dict[str, dict] = {}
+        self._prefetch_served: "OrderedDict[str, set]" = OrderedDict()
+        self._prefetch_done_q: queue.Queue = queue.Queue()
+        self._last_prefetch_scan = 0.0
+        # Tiering counters (metrics() mirrors + /metrics):
+        # pages restored ahead of admission, restored pages the target
+        # actually attached, prefetches that completed after their
+        # target admitted, proactive swap-outs, and swap-ins.
+        self.prefetch_pages = 0
+        self.prefetch_hits = 0
+        self.prefetch_late = 0
+        self.proactive_offloads = 0
+        self.swap_ins = 0
         # Fleet build-info (docs/observability.md "Fleet plane"): the
         # AOT lattice manifest hash + jax version + feature flags, so
         # fleet scrapes can detect config skew between instances.
@@ -856,10 +877,21 @@ class TPUEngine(AsyncEngine):
             # (bounded) so a graceful drain doesn't silently discard
             # queued host-tier offloads — every committed page is a
             # recompute the next instance of this prefix never pays.
+            # The drain also completes in-flight prefetch fetches;
+            # their reservation leases are returned below (no inject —
+            # there is no loop left to consume the pages).
             self._flush_offloads()
             self.copy_stream.drain()
             self.copy_stream.stop()
             self.copy_stream = None
+        while not self._prefetch_done_q.empty():
+            try:
+                job, _fetched = self._prefetch_done_q.get_nowait()
+            except queue.Empty:
+                break
+            self._prefetch_inflight.pop(job["req"], None)  # dynlint: thread-ownership(loop thread joined before teardown flush)
+            if self.kv.lease_active(job["lease"]):
+                self.kv.confirm_lease(job["lease"])
 
     def prewarm(self, manifest=None, cache_dir: str = ""):
         """Warm-boot provisioning (docs/aot.md): compile/load every
@@ -1182,7 +1214,10 @@ class TPUEngine(AsyncEngine):
                     # tier must see them even with no next dispatch) and
                     # publish on the idle path too: the gauges must decay
                     # to zero after the last request finishes, not freeze
-                    # on the final busy-loop snapshot.
+                    # on the final busy-loop snapshot. Completed
+                    # prefetches whose target vanished still need their
+                    # leases returned.
+                    self._apply_prefetches()
                     self._flush_offloads()
                     self._maybe_publish_gauges()
                     if self.profiler is not None:
@@ -1205,11 +1240,28 @@ class TPUEngine(AsyncEngine):
                     self.sched.reap_waiting()
                 # KV pressure: no window is in flight here (the chain
                 # broke above or never existed), so releasing a victim's
-                # pages cannot race a device write.
+                # pages cannot race a device write. Proactive offload
+                # first (docs/engine_perf.md "Predictive KV tiering"):
+                # swap a cold row's bytes to the host tier so the stall
+                # clears before the preemption grace ever expires —
+                # preemption stays the fallback.
+                self._maybe_proactive_offload()
                 self._maybe_preempt()
-                if not self._kv_pressure():
+                # Completed G2→G1 prefetches inject before this
+                # iteration's compute dispatch (stream order then makes
+                # the restored pages readable by anything admitted
+                # below); swapped rows rejoin before admission so
+                # newcomers can't starve them.
+                self._apply_prefetches()
+                self._try_swap_in()
+                if not self._kv_pressure() and not self._swapped_rows():
                     while (admitted := self.sched.admit_next()) is not None:
                         self._on_admitted(admitted)
+                # Plan new prefetches over whatever is STILL waiting
+                # (couldn't admit: slots full or pool pressure) — the
+                # exact window where restoring ahead of admission
+                # overlaps the current batch's compute.
+                self._plan_prefetch()
                 self._maybe_publish_gauges()
                 progressed = False
                 prefilling = [
@@ -1276,6 +1328,7 @@ class TPUEngine(AsyncEngine):
         captured at submission."""
         now = time.time()
         seq.admitted_at = now
+        self._note_prefetch_admission(seq)
         if self.flight is not None:
             self.flight.record(
                 "admit",
@@ -1325,6 +1378,7 @@ class TPUEngine(AsyncEngine):
                         "generated": s.generated,
                         "pages": len(s.page_ids),
                         "stalled": bool(s.stalled_since),
+                        "swapped": s.swap is not None,
                         "preemptions": s.preemptions,
                     }
                 )
@@ -1362,6 +1416,10 @@ class TPUEngine(AsyncEngine):
             self._last_gauge_pub = now
             tel = get_telemetry()
             tel.publish_engine_gauges(self.metrics())
+            if self.host_pool is not None:
+                # G2 tier occupancy (docs/engine_perf.md "Predictive KV
+                # tiering"): host-tier pressure is fleet-visible.
+                tel.kv_host_pages.set(self.host_pool.resident)
             # Prefix-hit counters advance by delta (the page manager is
             # telemetry-free; its in-object counters are authoritative).
             for kind, total in self.kv.prefix_hits.items():
@@ -1562,6 +1620,452 @@ class TPUEngine(AsyncEngine):
             victim.request_id, victim.priority, generated, freed,
             victim.preemptions, self.cfg.max_preemptions_per_seq,
         )
+
+    # ------------------------------------------------- predictive KV tiering
+    def _swapped_rows(self) -> bool:
+        """True while any ACTIVE row's cold pages live in the host tier
+        (swap-in pending). Admission pauses — a newcomer's allocation
+        would take the very pages the swapped rows are waiting for."""
+        return any(
+            s is not None and s.swap is not None for s in self.sched.slots
+        )
+
+    def _maybe_proactive_offload(self) -> None:
+        """Proactive cold-tail offload (docs/engine_perf.md "Predictive
+        KV tiering"): once any row has been hard-stalled past the
+        (short) proactive grace — and before ``preempt_stall_grace_s``
+        expires — swap the coldest eligible row's refcount-1,
+        non-leased pages out to the host tier through the existing
+        eviction write-back. Bytes are preserved, so the row resumes
+        token-identically once pressure clears; preemption (which
+        re-prefills) becomes the fallback, not the policy. At most one
+        victim per iteration: every swap frees pages, so the stalled
+        row re-checks before a second victim pays."""
+        grace = self.cfg.proactive_offload_grace_s
+        if grace < 0 or self.copy_stream is None or self.host_pool is None:
+            return
+        now = time.time()
+        if not any(
+            s is not None
+            and s.stalled_since
+            and now - s.stalled_since >= grace
+            for s in self.sched.slots
+        ):
+            return
+        # Victims: ACTIVE rows, not already swapped, no deferred
+        # finish, not disagg-extract. A stalled row is normally exempt
+        # (freeing the sole starving row's pages feeds nobody) — but
+        # when SEVERAL rows are starving, swapping the coldest stalled
+        # one feeds the rest, so the exemption lifts. Same cold-first
+        # order as preemption: lowest priority, youngest.
+        n_stalled = sum(
+            1
+            for s in self.sched.slots
+            if s is not None and s.stalled_since
+        )
+        cands = [
+            s
+            for s in self.sched.slots
+            if s is not None
+            and s.state is SeqState.ACTIVE
+            and s.swap is None
+            and (n_stalled >= 2 or not s.stalled_since)
+            and s.pending_finish is None
+            and s.extract_cb is None
+        ]
+        for victim in sorted(cands, key=lambda s: (s.priority, -s.submitted_at)):
+            swapped = self._swap_out(victim)
+            if swapped:
+                # Relief just landed: restart the stalled rows' grace
+                # clocks so preemption only fires if the freed pages
+                # were NOT enough (a cold compile can block the loop
+                # past the whole grace before this swap ever ran — the
+                # stale clock must not preempt in the same breath).
+                for s in self.sched.slots:
+                    if s is not None and s.stalled_since:
+                        s.stalled_since = now
+                return
+            if swapped is None:
+                # Copy stream saturated: every further victim would
+                # dispatch a gather only to shed it — stop this pass.
+                return
+
+    def _swap_out(self, victim: Sequence) -> bool | None:
+        """Swap one row's cold pages to the host tier: refcount-1
+        non-leased pages either write back under their content key
+        (one batched gather into the CopyStream — the eviction path)
+        or, when registered, simply park in the reclaimable LRU (the
+        normal eviction write-back covers them if they are taken);
+        shared and leased pages stay pinned by the row's ref. The row
+        keeps its slot and all host-side state — only its page table
+        shrinks to the kept pages, with the :class:`SwapRecord` as the
+        restore ledger. Returns False when this victim had nothing
+        freeable (the caller tries the next), and None when the copy
+        stream shed the write-back batch — swap bytes, unlike an
+        eviction's, are not recomputable, so the pages stay resident,
+        and the caller must stop burning gather dispatches on further
+        victims this pass."""
+        entries, off_pids, off_keys, park_pids, drop_pids = plan_swap_entries(
+            victim.page_ids,
+            victim.tokens,
+            self.cfg.page_size,
+            self.kv.page_ref,
+            self.kv.page_hash,
+            shared_tail_pid=victim.shared_tail_pid,
+        )
+        freed = len(off_pids) + len(park_pids) + len(drop_pids)
+        if freed == 0:
+            return False
+        record = SwapRecord(entries=entries, committed=not off_pids)
+        if off_pids:
+            k_b, v_b = self._gather_page_batch(off_pids, kind="offload")
+
+            def _mark_committed(rec=record):
+                # Copy-thread callback, post-store: the swap's bytes
+                # are now fetchable from the host pool (single boolean
+                # write; the loop polls it before any swap-in fetch).
+                rec.committed = True
+
+            if not self.copy_stream.offload_batch(
+                off_keys, k_b, v_b, on_stored=_mark_committed
+            ):
+                return None  # stream saturated: keep the row resident
+        # The gather (if any) is already dispatched: stream order
+        # protects the page content from whatever reuses the freed
+        # pages next — the same guarantee the eviction path rides.
+        self.kv.release_sequence(off_pids + park_pids + drop_pids)
+        victim.page_ids = [pid for kind, pid in entries if kind == "kept"]
+        victim.swap = record
+        victim.swapped_since = time.time()
+        victim.swaps += 1
+        # A stalled victim is no longer starving — it is parked in the
+        # host tier (swap-in owns its liveness now).
+        victim.stalled = False
+        victim.stalled_since = 0.0
+        self.proactive_offloads += 1
+        tel = get_telemetry()
+        tel.kv_proactive_offloads.inc()
+        tel.kv_page_moves.labels("offload").inc(len(off_pids))
+        if self.flight is not None:
+            self.flight.record(
+                "swap_out",
+                req=victim.request_id,
+                slot=victim.slot,
+                pages=freed,
+                kept=len(victim.page_ids),
+            )
+        log.info(
+            "KV pressure: proactively offloaded %d page(s) of request %s "
+            "to the host tier (%d kept resident); preemption avoided",
+            freed, victim.request_id, len(victim.page_ids),
+        )
+        return True
+
+    def _try_swap_in(self) -> None:
+        """Restore swapped rows (oldest swap first) once the pool can
+        cover their non-resident pages: re-attach blocks that never
+        left the device (parked, or held by a sharer), fetch the rest
+        from the host tier, and rebuild the page table in one batched
+        scatter. A host-tier miss (the LRU dropped a swapped page)
+        falls back to preemption — the deterministic continuation
+        re-prefills, so the stream is still token-identical."""
+        swapped = [
+            s
+            for s in self.sched.slots
+            if s is not None and s.swap is not None
+            and s.state is SeqState.ACTIVE
+        ]
+        if not swapped:
+            return
+        if self._kv_pressure():
+            # Pages freed under pressure feed the hard-stalled rows
+            # FIRST (they claim them at their next dispatch); a swap-in
+            # grabbing them here would ping-pong the same page between
+            # a starving row and the row just swapped out for it.
+            return
+        # Evictions still buffered on the loop would read as host-tier
+        # misses below — hand them to the copy stream first (their
+        # gathers are stream-ordered ahead of anything that reuses the
+        # pages, exactly as at a compute dispatch).
+        self._flush_offloads()
+        for seq in sorted(swapped, key=lambda s: s.swapped_since):
+            rec: SwapRecord = seq.swap
+            if not rec.committed:
+                continue  # write-back still on the copy thread
+            attach: dict[int, int] = {}
+            fetch_plan: list[tuple[int, int]] = []
+            for i, (kind, val) in enumerate(rec.entries):
+                if kind == "hash":
+                    pid = self.kv.resident_page(val)
+                    if pid is not None:
+                        attach[i] = pid
+                    else:
+                        fetch_plan.append((i, val))
+                elif kind == "host":
+                    fetch_plan.append((i, val))
+            # Headroom: fresh pages needed PLUS the parked (ref-0)
+            # blocks the re-attach below revives — both come out of
+            # free_pages (a parked attach leaves the reclaimable LRU).
+            parked_attaches = sum(
+                1 for pid in attach.values() if self.kv.page_ref(pid) == 0
+            )
+            if len(fetch_plan) + parked_attaches > self.kv.free_pages:
+                continue  # not enough headroom yet; retry next iteration
+            # Fetch the host bytes BEFORE any mutation: a miss means the
+            # host LRU dropped a swapped page — preempt instead (the
+            # continuation re-prefills; counter-based sampling keeps the
+            # stream token-identical).
+            fetched: dict[int, tuple] = {}
+            miss = False
+            for i, key in fetch_plan:
+                data = self.host_pool.fetch(key)
+                if data is None:
+                    miss = True
+                    break
+                fetched[i] = data
+            if miss:
+                if self.copy_stream is not None and self.copy_stream.pending:
+                    # An eviction write-back for a released "hash" page
+                    # may still be in flight on the copy thread — a
+                    # retry next iteration beats a spurious preemption.
+                    continue
+                self._preempt_swapped(seq)
+                continue
+            for pid in attach.values():
+                self.kv.attach_page(pid)
+            new_ids: list[int] = []
+            taken: list[int] = list(attach.values())
+            inj: list[tuple[int, object, object]] = []
+            dry = False
+            for i, (kind, val) in enumerate(rec.entries):
+                if kind == "kept":
+                    new_ids.append(val)
+                elif i in attach:
+                    new_ids.append(attach[i])
+                else:
+                    pid = self.kv.allocate_page()
+                    if pid is None:
+                        dry = True  # raced our own headroom check
+                        break
+                    new_ids.append(pid)
+                    taken.append(pid)
+                    inj.append((pid, fetched[i][0], fetched[i][1]))
+            if dry:
+                # Undo this attempt's refs and preempt — strictly rarer
+                # than a host miss, but it must not leak pages.
+                self.kv.release_sequence(taken)
+                self._preempt_swapped(seq)
+                continue
+            if inj:
+                self._inject_page_batch(
+                    [p for p, _, _ in inj],
+                    [k for _, k, _ in inj],
+                    [v for _, _, v in inj],
+                    op="swap_in",
+                )
+            seq.page_ids = new_ids
+            seq.swap = None
+            seq.swapped_since = 0.0
+            self.swap_ins += 1
+            get_telemetry().kv_swap_ins.inc()
+            if self.flight is not None:
+                self.flight.record(
+                    "swap_in",
+                    req=seq.request_id,
+                    slot=seq.slot,
+                    pages=len(inj),
+                    attached=len(attach),
+                )
+
+    def _preempt_swapped(self, seq: Sequence) -> None:
+        """Swap-in fallback: the host tier lost a swapped page, so the
+        row requeues as a deterministic continuation (full re-prefill).
+        Rides the normal preemption surgery — ``Scheduler.preempt``
+        clears the swap record."""
+        self.sched.preempt(seq)
+        self.preempted += 1
+        get_telemetry().preemptions.labels("swap_miss").inc()
+        log.warning(
+            "request %s: swapped KV state could not be restored (host-"
+            "tier miss); falling back to preemption (deterministic "
+            "continuation)",
+            seq.request_id,
+        )
+
+    def _plan_prefetch(self) -> None:
+        """Scan the head of the waiting queue for prompts whose next
+        pages are host-resident and restore them AHEAD of admission:
+        target pages allocate against free+parked headroom minus
+        ``prefetch_reserve_pages`` (evicting a parked LRU page is
+        lossless — its content writes back to the host tier first),
+        are pinned under a lease while the copy thread fetches the
+        bytes, and are injected + registered by
+        :meth:`_apply_prefetches` before a later compute dispatch — so
+        the restore's host copy overlaps device compute and the
+        admission that needs the pages finds them already resident
+        (a plain G1 prefix hit)."""
+        cfg = self.cfg
+        if (
+            not cfg.kv_prefetch
+            or self.host_pool is None
+            or self.copy_stream is None
+            or not self.kv.sharing
+            or not self.sched.waiting
+        ):
+            return
+        now = time.monotonic()
+        if now - self._last_prefetch_scan < 0.01:
+            return
+        self._last_prefetch_scan = now
+        # Budget: free + parked minus the decode-growth reserve. Taking
+        # a parked page is fine — its content writes back to the host
+        # tier on eviction, so prefetch trades LRU-cold cache for
+        # predicted-hot cache without losing bytes.
+        budget = self.kv.free_pages - cfg.prefetch_reserve_pages
+        if budget <= 0:
+            return
+        ps = cfg.page_size
+        tel = get_telemetry()
+        scanned = 0
+        for seq in list(self.sched.waiting):
+            if budget <= 0 or scanned >= cfg.prefetch_depth:
+                return
+            scanned += 1
+            rid = seq.request_id
+            if rid in self._prefetch_inflight or rid in self._prefetch_served:
+                continue
+            if seq.forecast_hashes is None:
+                seq.forecast_hashes = compute_block_hashes_for_seq(
+                    seq.prompt, ps
+                )
+            hashes = seq.forecast_hashes
+            if not hashes:
+                continue
+            matched = self.kv.match_resident_hashes(hashes)
+            rest = hashes[len(matched):]
+            if not rest:
+                continue
+            g2 = self.host_pool.match_chain(rest)[:budget]
+            if not g2:
+                continue
+            pids: list[int] = []
+            for _ in g2:
+                pid = self.kv.allocate_page()
+                if pid is None:
+                    break
+                pids.append(pid)
+            if not pids:
+                return
+            g2 = g2[: len(pids)]
+            # Pin the reserved pages under a lease: they are audit-
+            # visible holders while the fetch is in flight, and the
+            # reaper returns them if anything wedges.
+            lease = self.kv.grant_lease(pids, cfg.kv_lease_ttl_s)
+            self.kv.release_sequence(pids)
+            budget -= len(pids)
+            start = len(matched)
+            job = {
+                "req": rid,
+                "pids": pids,
+                "lease": lease,
+                "parent": hashes[start - 1] if start else None,
+                "blocks": [
+                    list(seq.prompt[(start + j) * ps : (start + j + 1) * ps])
+                    for j in range(len(g2))
+                ],
+            }
+            if not self.copy_stream.fetch_batch(g2, job, self._on_prefetched):
+                # Stream saturated: give the pages back and stop
+                # planning this pass.
+                self.kv.confirm_lease(lease)
+                tel.kv_prefetch_pages.labels("dropped").inc(len(pids))
+                return
+            self._prefetch_inflight[rid] = job
+
+    def _on_prefetched(self, job: dict, fetched: list) -> None:
+        """CopyStream completion callback — runs ON THE COPY THREAD;
+        only queues the result for the loop thread (the page manager's
+        single writer) and wakes it."""
+        self._prefetch_done_q.put((job, fetched))
+        self._wake.set()
+
+    def _apply_prefetches(self) -> None:
+        """Loop-thread side of the prefetch direction: register the
+        fetched blocks (pending-fill), inject them in one batched
+        scatter — dispatched BEFORE this iteration's compute, so stream
+        order protects every later read — and park them matchable by
+        confirming the reservation lease. Pages whose content got
+        registered by someone else mid-fetch (the target admitted and
+        prefilled) just return to the free list."""
+        while True:
+            try:
+                job, fetched = self._prefetch_done_q.get_nowait()
+            except queue.Empty:
+                return
+            self._prefetch_inflight.pop(job["req"], None)
+            if not self.kv.lease_active(job["lease"]):
+                continue  # reaped: the pages were already reclaimed
+            inj: list[tuple[int, object, object]] = []
+            served: set[int] = set()
+            parent = job["parent"]
+            for j, (h, k_pg, v_pg) in enumerate(fetched):
+                served.add(h)
+                if self.kv.resident_page(h) is not None:
+                    parent = h
+                    continue  # someone already owns this content
+                pid = job["pids"][j]
+                self.kv.register_full_page(
+                    pid, h, parent_hash=parent, tokens=job["blocks"][j],
+                    content_ready=False,
+                )
+                inj.append((pid, k_pg, v_pg))
+                parent = h
+            if inj:
+                pids = [p for p, _, _ in inj]
+                self._inject_page_batch(
+                    pids,
+                    [k for _, k, _ in inj],
+                    [v for _, _, v in inj],
+                    op="prefetch",
+                )
+                self.kv.mark_filled(pids)
+                self.prefetch_pages += len(inj)
+                get_telemetry().kv_prefetch_pages.labels("restored").inc(
+                    len(inj)
+                )
+                if self.flight is not None:
+                    self.flight.record(
+                        "prefetch", req=job["req"], pages=len(inj)
+                    )
+            if served:
+                self._prefetch_served[job["req"]] = served
+                while len(self._prefetch_served) > 256:
+                    self._prefetch_served.popitem(last=False)
+            # Registered + filled pages park in the reclaimable LRU
+            # (matchable by the admission that asked for them); skipped
+            # pages return to the free list.
+            self.kv.confirm_lease(job["lease"])
+
+    def _note_prefetch_admission(self, seq: Sequence) -> None:
+        """Hit/late attribution at admission (docs/observability.md):
+        restored pages the admission's G1 match actually attached count
+        as hits; a target admitted while its fetch was still in flight
+        counts the prefetch late (the reactive path already covered
+        it)."""
+        tel = get_telemetry()
+        if seq.request_id in self._prefetch_inflight:
+            self.prefetch_late += 1
+            tel.kv_prefetch_pages.labels("late").inc()
+        served = self._prefetch_served.pop(seq.request_id, None)
+        if served:
+            hits = sum(
+                1
+                for h in seq.prompt_hashes[: seq.hashed_pages]
+                if h in served
+            )
+            if hits:
+                self.prefetch_hits += hits
+                tel.kv_prefetch_pages.labels("hit").inc(hits)
 
     def _poll_cancellations(self) -> None:
         now = time.time()
@@ -1909,6 +2413,11 @@ class TPUEngine(AsyncEngine):
         sampler: list[tuple[Sequence, int, int]] = []
         for seq in self.sched.slots:
             if seq is None or seq.state is not SeqState.ACTIVE:
+                continue
+            if seq.swap is not None:
+                # Proactively offloaded: the row's cold pages live in
+                # the host tier; it sits dispatches out until
+                # _try_swap_in restores them (token-identically).
                 continue
             if seq.shared_tail_pid >= 0 and not self._resolve_shared_tail(seq):
                 # The shared tail page must be private before this row's
@@ -2875,6 +3384,14 @@ class TPUEngine(AsyncEngine):
             m["host_cache_resident"] = self.host_pool.resident
             m["host_cache_hits"] = self.host_pool.hits
             m["host_cache_stores"] = self.host_pool.stores
+        # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+        # tiering"): G2→G1 prefetch outcomes and proactive-offload
+        # (swap) traffic — bench.py's offload-pressure axis reads these.
+        m["kv_prefetch_pages"] = self.prefetch_pages
+        m["kv_prefetch_hits"] = self.prefetch_hits
+        m["kv_prefetch_late"] = self.prefetch_late
+        m["kv_proactive_offloads"] = self.proactive_offloads
+        m["kv_swap_ins"] = self.swap_ins
         # Fleet observability plane (docs/observability.md "Fleet
         # plane"): conservation-auditor violations (0 in any healthy
         # run), the config-skew fingerprint, and this process's per-link

@@ -236,6 +236,10 @@ class KvPageManager:
             "hbm_page_occupancy": self.usage,
             "offload_hit_rate": self.offload_hit_rate(),
             "kv_shared_pages": self.shared_pages,
+            # G2 tier occupancy (docs/engine_perf.md "Predictive KV
+            # tiering"): host-resident pages, so fleet views see
+            # host-tier pressure (mirrored as dynamo_kv_host_pages).
+            "kv_host_pages": self.host_pool.resident if self.host_pool else 0,
         }
 
     def _note_active(self) -> None:
@@ -409,6 +413,37 @@ class KvPageManager:
         pid = self._take_free()
         self._note_active()
         return pid
+
+    # -------------------------------------------- tiering accessors
+    def match_resident_hashes(self, hashes: list[int]) -> list[int]:
+        """Device-resident (G1) prefix of a block-hash chain — the
+        footprint forecast's and the prefetch planner's read-only
+        match (no refs taken)."""
+        pages, _ = self._match_hashes(hashes)
+        return pages
+
+    def page_ref(self, page_id: int) -> int:
+        return self._records[page_id].ref_count
+
+    def page_hash(self, page_id: int) -> int | None:
+        return self._records[page_id].seq_hash
+
+    def resident_page(self, seq_hash: int) -> int | None:
+        """The device page holding this registered, *filled* block (or
+        None) — swap-in re-attaches through this instead of fetching
+        from the host tier when the content never left the device."""
+        pid = self._by_hash.get(seq_hash)
+        if pid is None or not self._records[pid].filled:
+            return None
+        return pid
+
+    def attach_page(self, page_id: int) -> None:
+        """Take one reference on a resident page (swap-in re-attach of
+        a still-parked or shared block)."""
+        self._ref_page(page_id)
+
+    def lease_active(self, lease_id: str) -> bool:
+        return lease_id in self._leases
 
     # ------------------------------------------------------------- lifecycle
     def register_full_page(

@@ -105,21 +105,33 @@ class HostKvPool:
 
 
 class CopyStream:
-    """Background device→host materializer.
+    """Background device↔host copy stream.
 
-    The engine loop dispatches the on-device page gather (cheap, async)
-    and hands the resulting device arrays here; this thread blocks on the
-    transfer (``np.asarray``) and commits the page into the host pool —
-    the TPU analogue of the reference's CUDA ``CopyStream`` with
-    completion events (``kv/layer.rs:619+``).
+    Device→host (offload): the engine loop dispatches the on-device
+    page gather (cheap, async) and hands the resulting device arrays
+    here; this thread blocks on the transfer (``np.asarray``) and
+    commits the page into the host pool — the TPU analogue of the
+    reference's CUDA ``CopyStream`` with completion events
+    (``kv/layer.rs:619+``).
+
+    Host→device (prefetch, docs/engine_perf.md "Predictive KV
+    tiering"): :meth:`fetch_batch` copies requested pages *out* of the
+    host pool off the engine loop thread and hands them to a callback;
+    the engine loop then injects them with the existing batched
+    scatter — so a G2→G1 restore's host memcpy overlaps device compute
+    instead of serializing the admission path. One bounded queue
+    carries both directions, so :meth:`drain` and :meth:`stop` cover
+    prefetches exactly like offloads.
     """
 
     def __init__(self, pool: HostKvPool, max_inflight: int = 256):
         self.pool = pool
-        # Bounded: each entry pins a gathered K/V device-array pair, so a
-        # burst of evictions outpacing the blocking host transfers must
-        # shed load (the tier is a cache — dropping an offload only costs
-        # a future recompute) instead of growing HBM pressure unboundedly.
+        # Bounded: each offload entry pins a gathered K/V device-array
+        # pair, so a burst of evictions outpacing the blocking host
+        # transfers must shed load (the tier is a cache — dropping an
+        # offload only costs a future recompute) instead of growing HBM
+        # pressure unboundedly. Prefetches shed the same way (the
+        # caller releases the target pages and retries later).
         self._q: queue.Queue = queue.Queue(maxsize=max_inflight)
         self._thread = threading.Thread(
             target=self._run, name="kv-copy-stream", daemon=True
@@ -128,9 +140,17 @@ class CopyStream:
         self.dropped = 0
         self._thread.start()
 
+    @property
+    def pending(self) -> int:
+        """Queued-but-uncommitted items (both directions) — swap-in
+        uses this to tell "write-back still in flight" from a genuine
+        host-tier miss."""
+        return self._q.unfinished_tasks
+
     def offload_batch(
-        self, seq_hashes: list, k_dev, v_dev, on_synced=None
-    ) -> None:
+        self, seq_hashes: list, k_dev, v_dev, on_synced=None,
+        on_stored=None,
+    ) -> bool:
         """Coalesced offload: one gathered [L, n, ps, HkvD] K/V pair
         covering ``len(seq_hashes)`` pages (page axis 1). The worker
         materializes the whole batch with ONE host transfer and commits
@@ -138,11 +158,37 @@ class CopyStream:
         instead of one per page. ``on_synced`` (if given) fires right
         after that existing host transfer completes — the dispatch
         profiler's consume point for the ``offload`` kind, so in-flight
-        timing rides the sync the stream was doing anyway."""
+        timing rides the sync the stream was doing anyway; ``on_stored``
+        fires after the batch is COMMITTED to the pool (the swap
+        record's fetchable-from-host signal). Returns False when the
+        stream is saturated and the batch was shed (proactive swap-out
+        must then keep the pages resident — its bytes, unlike an
+        eviction's, are not recomputable)."""
         try:
-            self._q.put_nowait((list(seq_hashes), k_dev, v_dev, on_synced))
+            self._q.put_nowait(
+                ("offload", list(seq_hashes), k_dev, v_dev, on_synced,
+                 on_stored)
+            )
+            return True
         except queue.Full:
             self.dropped += len(seq_hashes)
+            return False
+
+    def fetch_batch(self, seq_hashes: list, ctx, on_fetched) -> bool:
+        """G2→G1 direction: copy ``seq_hashes``' pages out of the host
+        pool on the copy thread and call ``on_fetched(ctx, fetched)``
+        with the ``(hash, k_page, v_page)`` prefix that was resident
+        (the walk stops at the first miss — a restored prefix must stay
+        chain-contiguous to be matchable). The callback runs ON THE
+        COPY THREAD; the engine's implementation just queues the result
+        for the loop thread. Returns False when the stream is
+        saturated (caller releases the reserved pages and retries)."""
+        try:
+            self._q.put_nowait(("fetch", list(seq_hashes), ctx, on_fetched))
+            return True
+        except queue.Full:
+            self.dropped += len(seq_hashes)
+            return False
 
     def drain(self, timeout: float = 10.0) -> None:
         """Block until every queued offload has *committed* (tests)."""
@@ -168,7 +214,20 @@ class CopyStream:
             try:
                 if item is None:
                     return
-                seq_hashes, k_dev, v_dev, on_synced = item
+                if item[0] == "fetch":
+                    _, seq_hashes, ctx, on_fetched = item
+                    fetched = []
+                    for h in seq_hashes:
+                        data = self.pool.fetch(h)
+                        if data is None:
+                            break  # chain broken: later pages unmatchable
+                        fetched.append((h, data[0], data[1]))
+                    try:
+                        on_fetched(ctx, fetched)
+                    except Exception:  # must not kill the stream
+                        log.exception("prefetch on_fetched callback failed")
+                    continue
+                _, seq_hashes, k_dev, v_dev, on_synced, on_stored = item
                 k_np, v_np = np.asarray(k_dev), np.asarray(v_dev)  # dynlint: sync-point(offload copy-thread transfer)
                 if on_synced is not None:
                     try:
@@ -177,7 +236,12 @@ class CopyStream:
                         log.exception("offload on_synced callback failed")
                 for j, h in enumerate(seq_hashes):
                     self.pool.store(h, k_np[:, j], v_np[:, j])
+                if on_stored is not None:
+                    try:
+                        on_stored()
+                    except Exception:  # bookkeeping must not break offload
+                        log.exception("offload on_stored callback failed")
             except Exception:  # never kill the stream on one bad page
-                log.exception("KV offload of page(s) %s failed", item[0])
+                log.exception("KV copy-stream item %s failed", item[0])
             finally:
                 self._q.task_done()

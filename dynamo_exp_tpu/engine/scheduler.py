@@ -26,11 +26,14 @@ from typing import TYPE_CHECKING, Callable
 if TYPE_CHECKING:
     import numpy as np
 
+from itertools import islice
+
 from ..protocols.common import BackendInput, FinishReason
 from ..telemetry import get_telemetry
 from ..tokens import chain_hash, compute_block_hash
 from .config import EngineConfig
 from .kv_manager import KvPageManager
+from .tiering import KvFootprintForecast, select_packed_index
 
 
 @dataclass
@@ -156,6 +159,20 @@ class Sequence:
     spec_draft_tokens: int = 0
     spec_accepted_tokens: int = 0
     spec_emitted_tokens: int = 0
+    # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+    # tiering"). Cached prompt block hashes for the admission footprint
+    # forecast and the prefetch planner (invalidated by preemption
+    # surgery — the prompt changes).
+    forecast_hashes: "list[int] | None" = None
+    # Packed admission: times this waiting sequence has been bypassed
+    # by a smaller forecast (bounded by packing_max_defers).
+    packing_defers: int = 0
+    # Proactive offload: the row's swap record while its cold pages
+    # live in the host tier (None = fully resident), when the swap
+    # began, and how many times this row has been swapped out.
+    swap: "object | None" = None
+    swapped_since: float = 0.0
+    swaps: int = 0
 
     @property
     def pos(self) -> int:
@@ -207,6 +224,9 @@ class Scheduler:
         # Set by the engine: () -> dict of dispatch-profiler attrs to
         # attach to the decode span (sim/fit.py fits from them).
         self.span_attrs: Callable[[], dict] | None = None
+        # Footprint-packed admission (docs/engine_perf.md "Predictive
+        # KV tiering"): None = plain first-fit FIFO.
+        self.forecast = KvFootprintForecast(kv, cfg) if cfg.kv_packing else None
 
     # --------------------------------------------------------------- intake
     def submit(self, seq: Sequence) -> None:
@@ -276,7 +296,7 @@ class Scheduler:
             slot = self.free_slot()
             if slot is None:
                 return None
-            seq = self.waiting[0]
+            seq = self._pick_admission()
             ps = self.kv.page_size
             if (
                 len(seq.prompt) > self.cfg.max_model_len
@@ -291,7 +311,7 @@ class Scheduler:
                 )
                 is None
             ):
-                self.waiting.popleft()
+                self._remove_waiting(seq)
                 seq.state = SeqState.FINISHED
                 seq.emit([], FinishReason.ERROR)
                 continue
@@ -300,7 +320,7 @@ class Scheduler:
             )
             if alloc is None:
                 return None  # pool exhausted; retry after some decode frees
-            self.waiting.popleft()
+            self._remove_waiting(seq)
             seq.page_ids, seq.cached_len = alloc.page_ids, alloc.cached_len
             seq.pending_uploads = alloc.uploads
             seq.prompt_hashes = alloc.hashes
@@ -326,6 +346,44 @@ class Scheduler:
             self.active_count += 1
             return seq
         return None
+
+    def _remove_waiting(self, seq: Sequence) -> None:
+        """Drop one sequence from the waiting deque by identity (packed
+        admission can pick past the head)."""
+        for i, s in enumerate(self.waiting):
+            if s is seq:
+                del self.waiting[i]
+                return
+
+    def _pick_admission(self) -> Sequence:
+        """The next sequence to try to admit: the head under plain
+        first-fit, or — with footprint packing on — the first waiting
+        sequence whose *lifetime* KV forecast fits the current
+        free-page headroom (docs/engine_perf.md "Predictive KV
+        tiering"). An oversize head that would be admitted only to
+        hard-stall mid-decode defers behind smaller work; when nothing's
+        forecast fits, the head is returned so packing never refuses an
+        admission first-fit would have made. Priority and starvation
+        guards live in :func:`~.tiering.select_packed_index`."""
+        head = self.waiting[0]
+        if self.forecast is None or len(self.waiting) == 1:
+            return head
+        headroom = self.forecast.headroom()
+        cand = list(islice(self.waiting, self.cfg.packing_scan_limit))
+        entries = [
+            (
+                self.forecast.forecast(s).fresh_pages <= headroom,
+                s.priority,
+                s.packing_defers,
+            )
+            for s in cand
+        ]
+        idx = select_packed_index(entries, self.cfg.packing_max_defers)
+        if idx is None or idx == 0:
+            return head
+        for s in cand[:idx]:
+            s.packing_defers += 1
+        return cand[idx]
 
     def _register_uploads(self, seq: Sequence, hashes: list[int]) -> None:
         """Pages coming back from the host tier are about to be device-
@@ -542,6 +600,14 @@ class Scheduler:
         seq.parent_hash = None
         seq.remote_kv = None
         seq.remote_prefilled = False
+        # Tiering state: the continuation's prompt is new (forecast
+        # hashes stale), its queue history resets, and any swap record
+        # dies with the old page table (host-tier entries it referenced
+        # simply age out of the LRU as unmatched cache).
+        seq.forecast_hashes = None
+        seq.packing_defers = 0
+        seq.swap = None
+        seq.swapped_since = 0.0
         seq.preemptions += 1
         seq.state = SeqState.WAITING
         self.waiting.append(seq)
@@ -574,6 +640,12 @@ class Scheduler:
             "request_total_slots": self.cfg.max_decode_slots,
             "request_stalled_slots": sum(
                 1 for s in self.slots if s is not None and s.stalled
+            ),
+            # Proactive offload (docs/engine_perf.md "Predictive KV
+            # tiering"): ACTIVE rows whose cold pages currently live in
+            # the host tier, awaiting swap-in.
+            "request_swapped_slots": sum(
+                1 for s in self.slots if s is not None and s.swap is not None
             ),
             "kv_active_blocks": self.kv.active_pages,
             "kv_total_blocks": self.kv.num_pages,

@@ -1,0 +1,226 @@
+"""Predictive KV tiering (docs/engine_perf.md "Predictive KV tiering").
+
+Three policies turn the G2 host tier from reactive to predictive:
+
+- **Footprint-packed admission**: the scheduler forecasts each waiting
+  sequence's lifetime KV footprint (prompt + generation budget, minus
+  the radix-matched resident prefix) and admits the first sequence
+  whose *forecast* fits the current free-page headroom — an oversize
+  head that would be admitted only to hard-stall mid-decode defers
+  behind smaller work instead (:func:`select_packed_index` keeps the
+  priority and starvation rules explicit and pure, shared verbatim by
+  the live scheduler and the cluster simulator).
+- **G2→G1 prefetch**: host-resident prefixes of *waiting* prompts are
+  restored ahead of admission, riding the CopyStream's new device-bound
+  direction, so the restore's host copy overlaps device compute instead
+  of landing inside the admission path.
+- **Proactive cold-tail offload**: under KV pressure the engine swaps
+  the coldest eligible row's refcount-1, non-leased pages out to the
+  host tier (bytes preserved — farthest-from-write-position content
+  first becomes host-tier cache) instead of waiting out the hard-stall
+  grace and preempting; the row resumes token-identically once the
+  bytes swap back in, and preemption becomes the fallback, not the
+  policy (:class:`SwapRecord` is the page-table ledger of one swapped
+  row).
+
+Pure host bookkeeping, single-writer like its consumers (engine loop
+thread / sim event loop); no device values ever reach this module.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence as _Seq
+
+from ..tokens import chain_hash, compute_block_hash, compute_block_hashes_for_seq
+
+# Tag mixed into the synthesized host key of a swapped *partial* tail
+# page so it can never collide with a real full-block chain hash (the
+# host pool is shared between the prefix cache and swap write-backs).
+_SWAP_TAIL_TAG = 0x517CC1B727220A95
+
+
+def footprint_pages(
+    prompt_len: int,
+    max_tokens: int,
+    page_size: int,
+    max_model_len: int | None = None,
+) -> int:
+    """Lifetime device-page footprint of one sequence: prompt plus the
+    full generation budget. The final sampled token rides out without
+    its KV written (engine semantics), hence the ``- 1``."""
+    tokens = prompt_len + max(max_tokens, 1) - 1
+    if max_model_len is not None:
+        tokens = min(tokens, max_model_len)
+    return max(-(-tokens // page_size), 1)
+
+
+def swap_tail_key(parent_hash: int | None, tokens: _Seq[int]) -> int:
+    """Deterministic host-pool key for a swapped partial tail page
+    (tokens written into the page so far, chained on the previous
+    page's sequence hash). Tagged so it lives outside the full-block
+    chain-hash space: a partial page must never be matchable as a
+    prefix block."""
+    return chain_hash(parent_hash, compute_block_hash(list(tokens))) ^ _SWAP_TAIL_TAG
+
+
+def select_packed_index(
+    entries: _Seq[tuple[bool, int, int]], max_defers: int
+) -> int | None:
+    """Packed-admission choice over the waiting queue's scanned head.
+
+    ``entries`` is ``(fits_headroom, priority, defers)`` per waiting
+    sequence in queue order. Returns the index to admit, or ``None``
+    when nothing's forecast fits (the caller falls back to plain
+    first-fit on the head, so packing only ever *reorders* — it can
+    never refuse an admission the reactive policy would have made).
+
+    Overload-protection semantics are preserved by construction:
+
+    - a candidate may only bypass deferred sequences of priority <= its
+      own (no priority inversion through packing);
+    - a sequence already bypassed ``max_defers`` times becomes a
+      barrier — nothing behind it is considered until it admits (no
+      starvation).
+    """
+    blocked_prio = -1
+    for i, (fits, prio, defers) in enumerate(entries):
+        if fits and prio >= blocked_prio:
+            return i
+        if defers >= max_defers:
+            break
+        if prio > blocked_prio:
+            blocked_prio = prio
+    return None
+
+
+@dataclass
+class SeqForecast:
+    """One waiting sequence's KV footprint forecast."""
+
+    total_pages: int  # lifetime footprint (prompt + budget), in pages
+    resident_pages: int  # G1 radix-matched prefix (no fresh allocation)
+    host_pages: int  # G2-resident beyond the G1 match (fresh page, no recompute)
+
+    @property
+    def fresh_pages(self) -> int:
+        """Device pages this sequence will allocate over its lifetime."""
+        return max(self.total_pages - self.resident_pages, 0)
+
+
+class KvFootprintForecast:
+    """Forecasts waiting sequences' device-page footprints against the
+    page manager's radix index and host tier. Prompt block hashes are
+    cached on the sequence (``Sequence.forecast_hashes``, invalidated
+    by preemption surgery) so the per-admission-pass cost is the
+    radix walk, not a rehash of every waiting prompt."""
+
+    def __init__(self, kv, cfg):
+        self.kv = kv
+        self.cfg = cfg
+
+    def headroom(self) -> int:
+        """Pages an admission could take right now (free + parked)."""
+        return self.kv.free_pages
+
+    def hashes_for(self, seq) -> list[int]:
+        if seq.forecast_hashes is None:
+            seq.forecast_hashes = compute_block_hashes_for_seq(
+                seq.prompt, self.kv.page_size
+            )
+        return seq.forecast_hashes
+
+    def forecast(self, seq) -> SeqForecast:
+        sc = seq.stop.stop_conditions
+        max_tokens = sc.max_tokens or self.cfg.default_max_tokens
+        total = footprint_pages(
+            len(seq.prompt), max_tokens, self.kv.page_size,
+            self.cfg.max_model_len,
+        )
+        resident = host = 0
+        if self.kv.sharing:
+            hashes = self.hashes_for(seq)
+            resident = len(self.kv.match_resident_hashes(hashes))
+            if self.kv.host_pool is not None:
+                host = len(self.kv.host_pool.match_chain(hashes[resident:]))
+        return SeqForecast(total, resident, host)
+
+
+@dataclass
+class SwapRecord:
+    """Page-table ledger of one proactively offloaded (swapped) row.
+
+    ``entries`` covers the row's written pages in order; each entry is
+
+    - ``("kept", pid)`` — shared / leased page the row kept its ref on
+      (pinned resident; rejoins the table as-is),
+    - ``("hash", seq_hash)`` — registered page released to the parked
+      LRU; swap-in re-attaches it if still resident, else restores it
+      from the host tier by its real chain hash,
+    - ``("host", key)`` — unregistered page (partial tail or
+      duplicate-content block) written back under ``key``; swap-in must
+      fetch it (a host-tier miss falls back to preemption).
+
+    Unwritten growth pages are dropped at swap-out and re-allocated by
+    the normal decode path after swap-in.
+
+    ``committed`` flips once the CopyStream has stored the swap's
+    write-back batch into the host pool (set from the copy thread —
+    single boolean write, read by the loop; the same cross-thread
+    pattern as the profiler's ``on_synced``): swap-in must not fetch
+    before it, or it would read a miss for bytes still in flight.
+    """
+
+    entries: list[tuple[str, int]] = field(default_factory=list)
+    committed: bool = False
+
+    @property
+    def nonresident_pages(self) -> int:
+        return sum(1 for kind, _ in self.entries if kind != "kept")
+
+
+def plan_swap_entries(
+    page_ids: _Seq[int],
+    tokens: _Seq[int],
+    page_size: int,
+    page_ref,
+    page_hash,
+    shared_tail_pid: int = -1,
+) -> tuple[list[tuple[str, int]], list[int], list[int], list[int], list[int]]:
+    """Classify one row's pages for swap-out (pure; shared by the
+    engine and the unit tests).
+
+    Returns ``(entries, offload_pids, offload_keys, park_pids,
+    drop_pids)``: pages to write back to the host tier under keys,
+    registered pages to simply release into the parked LRU, and
+    unwritten growth pages to drop. ``page_ref``/``page_hash`` are
+    accessors into the page manager."""
+    written = max(len(tokens) - 1, 0)  # KV exists through position written-1
+    full = written // page_size
+    chain = compute_block_hashes_for_seq(list(tokens[: full * page_size]), page_size)
+    entries: list[tuple[str, int]] = []
+    off_pids: list[int] = []
+    off_keys: list[int] = []
+    park_pids: list[int] = []
+    drop_pids: list[int] = []
+    for i, pid in enumerate(page_ids):
+        if i * page_size >= written:
+            drop_pids.append(pid)  # no KV written yet: nothing to keep
+            continue
+        if page_ref(pid) != 1 or pid == shared_tail_pid:
+            entries.append(("kept", pid))
+            continue
+        h = page_hash(pid)
+        if h is not None:
+            entries.append(("hash", h))
+            park_pids.append(pid)
+            continue
+        if i < full:
+            key = chain[i]  # full block, unregistered (duplicate content)
+        else:
+            parent = chain[i - 1] if i else None
+            key = swap_tail_key(parent, tokens[i * page_size : written])
+        entries.append(("host", key))
+        off_pids.append(pid)
+        off_keys.append(key)
+    return entries, off_pids, off_keys, park_pids, drop_pids

@@ -334,6 +334,17 @@ def build_parser() -> argparse.ArgumentParser:
         "but every request pays full pages",
     )
     sim.add_argument(
+        "--host-pages", type=int, default=0,
+        help="modeled G2 host-tier pages per instance (docs/"
+        "engine_perf.md 'Predictive KV tiering'; enables proactive "
+        "offload under KV pressure; 0 = reactive baseline)",
+    )
+    sim.add_argument(
+        "--no-kv-packing", action="store_true",
+        help="first-fit admission baseline (disable footprint-packed "
+        "admission)",
+    )
+    sim.add_argument(
         "--period-s", type=float, default=300.0,
         help="diurnal workload: burst period in seconds (rate swings "
         "between --rps-start and --rps-end each period)",
@@ -788,6 +799,8 @@ def run_sim(args) -> int:
         service=service,
         record_events=args.events,
         prefix_sharing=not args.no_prefix_sharing,
+        host_pages_per_instance=args.host_pages,
+        kv_packing=not args.no_kv_packing,
     )
     sim = ClusterSim(cfg, workload)
     report = sim.run()

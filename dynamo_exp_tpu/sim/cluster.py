@@ -34,6 +34,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..engine.scheduler import SeqState, select_preemption_victim
+from ..engine.tiering import footprint_pages, select_packed_index
 from ..http.admission import (
     AdmissionController,
     RequestShedError,
@@ -93,6 +94,16 @@ class SimConfig:
     # match logic the live engine runs; False models the private-copy
     # baseline (every request pays full pages for its prefix).
     prefix_sharing: bool = True
+    # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+    # tiering"): footprint-packed admission (the same
+    # select_packed_index rule the live scheduler runs), and a modeled
+    # G2 host tier of this many pages per instance enabling proactive
+    # offload — under KV pressure a cold row's private pages swap to
+    # the host tier (restore billed at restore_s_per_page) instead of
+    # the row being preempted. 0 host pages = reactive baseline.
+    kv_packing: bool = True
+    host_pages_per_instance: int = 0
+    proactive_offload: bool = True
     # Fleet.
     initial_instances: int = 1
     provision_s: float | None = None  # None -> service model's value
@@ -119,7 +130,7 @@ class _SimSeq:
         "prompt_len", "remaining", "delivered", "round_budget",
         "gen_round", "itl", "decode_start", "first_token_at", "stalled",
         "stall_epoch", "cap_hit", "cached_tokens", "shared_hashes",
-        "shared_page_count",
+        "shared_page_count", "packing_defers", "swapped", "swap_pages",
     )
 
     def __init__(self, req: SimRequest, now: float):
@@ -149,13 +160,19 @@ class _SimSeq:
         # how many of its ``pages`` they back (the rest are private).
         self.shared_hashes: list[int] = []
         self.shared_page_count = 0
+        # Predictive tiering: packed-admission bypass count, and the
+        # proactive-offload swap state (private pages parked in the
+        # modeled host tier awaiting swap-in).
+        self.packing_defers = 0
+        self.swapped = False
+        self.swap_pages = 0
 
 
 class _SimInstance:
     __slots__ = (
         "id", "cfg", "waiting", "bound", "stall_queue", "pages_free",
         "metrics", "draining", "prefix_index", "shared_refs", "parked",
-        "born_at", "preemptions",
+        "born_at", "preemptions", "host_free", "swap_queue",
     )
 
     def __init__(self, iid: int, cfg: SimConfig, now: float):
@@ -168,6 +185,10 @@ class _SimInstance:
         self.draining = False
         self.born_at = now
         self.preemptions = 0  # per-instance share of report.preemptions
+        # Predictive tiering: modeled G2 host-tier capacity and the
+        # FIFO of proactively offloaded rows awaiting swap-in.
+        self.host_free = cfg.host_pages_per_instance
+        self.swap_queue: list[_SimSeq] = []
         # Prefix sharing (docs/prefix_sharing.md): the SAME radix index
         # the live page manager matches against, over synthetic per-
         # group block chains; refcounts per resident block, plus the
@@ -492,35 +513,78 @@ class ClusterSim:
         self._pump(inst)
 
     # ---------------------------------------------------------- admission
+    def _pick_waiting(self, inst: _SimInstance) -> _SimSeq:
+        """The next admission candidate: the head under plain first-fit
+        or — with footprint packing on — the first waiting sequence
+        whose lifetime forecast fits the free pool, through the SAME
+        :func:`~dynamo_exp_tpu.engine.tiering.select_packed_index` rule
+        the live scheduler runs (priority and starvation guards
+        included)."""
+        if not self.cfg.kv_packing or len(inst.waiting) <= 1:
+            return inst.waiting[0]
+        ps = self.cfg.page_size
+        cand = []
+        entries = []
+        for i, s in enumerate(inst.waiting):
+            if i >= 16:
+                break
+            total = footprint_pages(s.prompt_len, s.remaining, ps)
+            resident = 0
+            if self.cfg.prefix_sharing and s.req.prefix_group >= 0:
+                n_shared = min(s.req.prefix_len, s.prompt_len) // ps
+                resident = len(
+                    inst.prefix_index.match_hashes(
+                        self._group_hashes(s.req.prefix_group, n_shared)
+                    )
+                )
+            fits = max(total - resident, 0) <= inst.pages_free
+            cand.append(s)
+            entries.append((fits, s.priority, s.packing_defers))
+        idx = select_packed_index(entries, max_defers=64)
+        if idx is None or idx == 0:
+            return inst.waiting[0]
+        for s in cand[:idx]:
+            s.packing_defers += 1
+        return cand[idx]
+
+    @staticmethod
+    def _remove_waiting(inst: _SimInstance, seq: _SimSeq) -> None:
+        for i, s in enumerate(inst.waiting):
+            if s is seq:
+                del inst.waiting[i]
+                return
+
     def _pump(self, inst: _SimInstance) -> None:
         """Engine-side admission: bind waiting work to free slots while
         pages allow. Mirrors the live loop's `_kv_pressure` gate —
-        nothing is admitted while any bound row is hard-stalled, so
-        newcomers can't steal pages preemption just freed."""
+        nothing is admitted while any bound row is hard-stalled or
+        swapped out, so newcomers can't steal pages preemption (or a
+        pending swap-in) is waiting for."""
         cfg = self.cfg
         while (
             inst.waiting
             and not inst.stall_queue
+            and not inst.swap_queue
             and len(inst.bound) < cfg.slots_per_instance
         ):
-            seq = inst.waiting[0]
+            seq = self._pick_waiting(inst)
             capacity_tokens = cfg.pages_per_instance * cfg.page_size
             if seq.prompt_len > capacity_tokens:
                 # A prompt bigger than the whole pool can never be
                 # allocated — reject (finish=error) instead of waiting
                 # forever, exactly like Scheduler.admit_next.
-                inst.waiting.popleft()
+                self._remove_waiting(inst, seq)
                 self._finish(seq, "error")
                 continue
             if cfg.prefix_sharing and seq.req.prefix_group >= 0:
                 if not self._attach_prefix(inst, seq):
                     return  # pool exhausted; retry after a release
-                inst.waiting.popleft()
+                self._remove_waiting(inst, seq)
             else:
                 need = _pages(seq.prompt_len, cfg.page_size) - seq.pages
                 if need > inst.pages_free:
                     return  # pool exhausted; retry after a release
-                inst.waiting.popleft()
+                self._remove_waiting(inst, seq)
                 self._take_pages(inst, max(need, 0))
                 seq.pages += max(need, 0)
                 if seq.req.prefix_group >= 0:
@@ -618,7 +682,11 @@ class ClusterSim:
 
     def _hard_stall(self, seq: _SimSeq) -> None:
         """The row cannot feed its next token: start the preemption
-        grace clock (the engine's `stalled_since`)."""
+        grace clock (the engine's `stalled_since`). With proactive
+        offload enabled (a modeled host tier), a cold row's private
+        pages swap out immediately — the live engine's
+        ``proactive_offload_grace_s=0`` default — so the grace clock
+        usually never expires and preemption stays the fallback."""
         if seq.stalled:
             return
         seq.stalled = True
@@ -630,11 +698,82 @@ class ClusterSim:
         inst = seq.instance
         inst.stall_queue.append(seq)
         self._log("req %d hard-stalled on inst %d", seq.req.index, inst.id)
+        if (
+            self.cfg.proactive_offload
+            and self.cfg.host_pages_per_instance > 0
+            and self._proactive_swap(inst)
+        ):
+            self._feed_stalled(inst)
+            if not seq.stalled:
+                return  # swap freed enough; no grace clock needed
         grace = self.cfg.preempt_stall_grace_s
         if grace >= 0:
             self.loop.after(
                 grace, self._on_grace, seq, seq.epoch, seq.stall_epoch
             )
+
+    def _proactive_swap(self, inst: _SimInstance) -> bool:
+        """Swap the coldest eligible row's private pages to the modeled
+        host tier (the live ``_swap_out``): lowest priority, youngest,
+        not itself stalled or already swapped. Returns True when pages
+        were freed."""
+        # Mirror of the live victim rule: stalled rows are exempt
+        # unless several are starving (then swapping the coldest
+        # stalled one feeds the rest).
+        n_stalled = len(inst.stall_queue)
+        cands = [
+            s
+            for s in inst.bound
+            if s.state is SeqState.ACTIVE
+            and not s.swapped
+            and (n_stalled >= 2 or not s.stalled)
+            and s.pending_finish is None
+            and s.extract_cb is None
+        ]
+        for victim in sorted(
+            cands, key=lambda s: (s.priority, -s.submitted_at)
+        ):
+            freed = victim.pages - victim.shared_page_count
+            if freed <= 0 or freed > inst.host_free:
+                continue
+            # Progress so far this round (the live engine's host view
+            # of the row at the swap point).
+            gen = victim.gen_round
+            if victim.itl > 0:
+                gen = min(
+                    max(
+                        int((self.loop.now - victim.decode_start) / victim.itl),
+                        victim.gen_round,
+                    ),
+                    victim.round_budget,
+                )
+            victim.gen_round = gen
+            victim.epoch += 1  # cancel in-flight round timers
+            if victim.stalled:
+                victim.stalled = False
+                inst.stall_queue.remove(victim)
+            victim.swapped = True
+            victim.swap_pages = freed
+            victim.pages = victim.shared_page_count
+            inst.pages_free += freed
+            inst.host_free -= freed
+            inst.swap_queue.append(victim)
+            self.report.proactive_offloads += 1
+            self._log(
+                "req %d proactively offloaded on inst %d (%d pages)",
+                victim.req.index, inst.id, freed,
+            )
+            return True
+        return False
+
+    def _on_swap_resumed(self, seq: _SimSeq, epoch: int) -> None:
+        """Restore landed (host→device scatter billed): the row
+        resumes its round exactly where it left off."""
+        if seq.epoch != epoch or seq.state is not SeqState.ACTIVE:
+            return
+        seq.decode_start = self.loop.now - seq.gen_round * seq.itl
+        if not self._schedule_round_progress(seq):
+            self._hard_stall(seq)
 
     def _on_grace(self, seq: _SimSeq, epoch: int, stall_epoch: int) -> None:
         if (
@@ -666,9 +805,10 @@ class ClusterSim:
         budget reduced), exactly like Scheduler.preempt."""
         inst = victim.instance
         gen = victim.gen_round
-        if not victim.stalled and victim.itl > 0:
+        if not victim.stalled and not victim.swapped and victim.itl > 0:
             # decode_start is the round's *virtual* start (rebased on
             # stall-resume), so elapsed/itl = tokens actually produced.
+            # A swapped victim's progress was frozen at swap-out.
             gen = min(
                 max(
                     int((self.loop.now - victim.decode_start) / victim.itl),
@@ -676,6 +816,15 @@ class ClusterSim:
                 ),
                 victim.round_budget,
             )
+        if victim.swapped:
+            # Preempting a swapped row (swap-in starved too long, or
+            # the victim policy chose it): its host-tier reservation
+            # returns; the continuation re-prefills from scratch.
+            victim.swapped = False
+            inst.host_free += victim.swap_pages
+            victim.swap_pages = 0
+            if victim in inst.swap_queue:
+                inst.swap_queue.remove(victim)
         victim.epoch += 1
         victim.delivered += gen
         victim.prompt_len += gen
@@ -699,7 +848,8 @@ class ClusterSim:
 
     def _feed_stalled(self, inst: _SimInstance) -> None:
         """Freed pages go to hard-stalled rows first (admission stays
-        gated while any remain), then to engine admission."""
+        gated while any remain), then to pending swap-ins (oldest
+        first), then to engine admission — the live loop's order."""
         for seq in list(inst.stall_queue):
             if self._grab_round_pages(seq) <= 0:
                 continue
@@ -712,6 +862,19 @@ class ClusterSim:
                 seq.decode_start = self.loop.now - seq.gen_round * seq.itl
             # else: partial grab, still starved — keep queue position
             # and the already-armed grace clock.
+        for seq in list(inst.swap_queue):
+            if seq.swap_pages > inst.pages_free:
+                continue
+            self._take_pages(inst, seq.swap_pages)
+            seq.pages += seq.swap_pages
+            inst.host_free += seq.swap_pages
+            restore = seq.swap_pages * self.cfg.service.restore_s_per_page
+            seq.swap_pages = 0
+            seq.swapped = False
+            inst.swap_queue.remove(seq)
+            self.report.swap_ins += 1
+            self._log("req %d swapped back in on inst %d", seq.req.index, inst.id)
+            self.loop.after(restore, self._on_swap_resumed, seq, seq.epoch)
         self._pump(inst)
 
     def _on_decode_done(self, seq: _SimSeq, epoch: int) -> None:
@@ -735,6 +898,12 @@ class ClusterSim:
             if seq.stalled:
                 seq.stalled = False
                 inst.stall_queue.remove(seq)
+            if seq.swapped:
+                seq.swapped = False
+                inst.host_free += seq.swap_pages
+                seq.swap_pages = 0
+                if seq in inst.swap_queue:
+                    inst.swap_queue.remove(seq)
         self._open -= 1
         self.admission.release()
         if reason == "length":

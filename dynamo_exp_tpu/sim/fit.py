@@ -89,6 +89,11 @@ class ServiceTimeModel:
     # samples win when present — a fleet that warm-boots its instances
     # must plan with the warm landing delay, not the cold one.
     provision_s: float = 2.0
+    # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+    # tiering"): host→device restore cost per page when a proactively
+    # offloaded row swaps back in (one batched scatter per row; the
+    # per-page slope is what a bigger context pays).
+    restore_s_per_page: float = 0.0005
     # Speculative decoding (docs/speculative.md): tokens emitted per
     # decode dispatch per row (accepted draft prefix + correction).
     # ``itl_s`` is normalized to the per-*dispatch* interval — equal to

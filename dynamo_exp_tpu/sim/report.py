@@ -30,6 +30,13 @@ class SimReport:
     shed_503: int = 0
     errors: int = 0
     preemptions: int = 0
+    # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+    # tiering"): rows whose cold pages were proactively swapped to the
+    # modeled host tier instead of being preempted, and the swap-ins
+    # that restored them. Preemption is the fallback: a healthy tiered
+    # run shows proactive_offloads > 0 with preemptions near zero.
+    proactive_offloads: int = 0
+    swap_ins: int = 0
     # Requests whose prompt+max_tokens exceeded one instance's whole KV
     # pool and finished `length` at the capacity cap (live-engine
     # semantics) — counted in `completed`, but with tokens undelivered,

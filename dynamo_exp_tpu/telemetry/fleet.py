@@ -52,6 +52,10 @@ _FIELD_ALIASES = {
     "shed": ("requests_shed", "dynamo_requests_shed_total"),
     "ledger_violations": ("kv_ledger_violations",
                           "dynamo_kv_ledger_violations_total"),
+    # G2 host-tier occupancy (docs/engine_perf.md "Predictive KV
+    # tiering"): fleet views show host-tier pressure per instance.
+    "host_pages": ("kv_host_pages", "host_cache_resident",
+                   "dynamo_kv_host_pages"),
 }
 
 
@@ -264,6 +268,7 @@ class InstanceView:
     preemptions: int = 0
     shed: int = 0
     ledger_violations: int = 0
+    host_pages: int = 0
     draining: bool = False
     build_info: dict = field(default_factory=dict)
     links: list[dict] = field(default_factory=list)
@@ -284,6 +289,7 @@ class InstanceView:
         view.ledger_violations = int(
             _pick(m, _FIELD_ALIASES["ledger_violations"])
         )
+        view.host_pages = int(_pick(m, _FIELD_ALIASES["host_pages"]))
         view.draining = bool(m.get("draining", False))
         bi = m.get("build_info")
         if isinstance(bi, dict):
@@ -397,6 +403,7 @@ class FleetView:
             "preemptions": sum(m.preemptions for m in members),
             "shed": sum(m.shed for m in members),
             "ledger_violations": sum(m.ledger_violations for m in members),
+            "host_pages": sum(m.host_pages for m in members),
             "config_skew": self.config_skew(),
             "links": self.merged_links(),
         }
@@ -496,14 +503,15 @@ def render_top(view: FleetView) -> str:
         f"fleet: {roll['instances']} instance(s)"
         + (f", {len(roll['missing'])} missing" if roll["missing"] else "")
         + f" — running {roll['running']}, waiting {roll['waiting']}, "
-        f"occupancy {roll['occupancy_mean']:.0%}, shed {roll['shed']}, "
+        f"occupancy {roll['occupancy_mean']:.0%}, host pages "
+        f"{roll['host_pages']}, shed {roll['shed']}, "
         f"preempt {roll['preemptions']}, ledger violations "
         f"{roll['ledger_violations']}"
     ]
     if view.members:
         name_w = max(len(n) for n in view.members)
         lines.append(
-            f"{'instance':<{name_w}}  run wait  occ%  slots  shed  "
+            f"{'instance':<{name_w}}  run wait  occ%  slots  host  shed  "
             f"preempt  flags"
         )
         for name in sorted(view.members):
@@ -518,7 +526,7 @@ def render_top(view: FleetView) -> str:
             lines.append(
                 f"{name:<{name_w}}  {m.running:3d} {m.waiting:4d}  "
                 f"{m.occupancy:4.0%}  {m.active_slots}/{m.total_slots}"
-                f"  {m.shed:4d}  {m.preemptions:7d}  "
+                f"  {m.host_pages:4d}  {m.shed:4d}  {m.preemptions:7d}  "
                 f"{','.join(flags) or '-'}"
             )
     for name in sorted(view.missing):

@@ -221,6 +221,36 @@ class Telemetry:
             ["kind"],  # shared | restore | miss
             registry=self.registry,
         )
+        # Predictive KV tiering (docs/engine_perf.md "Predictive KV
+        # tiering"): G2 tier occupancy, prefetch outcomes, and
+        # proactive-offload (swap) traffic.
+        self.kv_host_pages = Gauge(
+            "dynamo_kv_host_pages",
+            "G2 host-tier KV pages currently resident (HostKvPool "
+            "occupancy — fleet views read host-tier pressure here)",
+            registry=self.registry,
+        )
+        self.kv_prefetch_pages = Counter(
+            "dynamo_kv_prefetch_pages_total",
+            "G2→G1 prefetch outcomes: restored (pages injected ahead "
+            "of admission), hit (restored pages the target admission "
+            "attached), late (fetch still in flight when the target "
+            "admitted), dropped (copy stream saturated)",
+            ["outcome"],  # restored | hit | late | dropped
+            registry=self.registry,
+        )
+        self.kv_proactive_offloads = Counter(
+            "dynamo_kv_proactive_offloads_total",
+            "Rows whose cold refcount-1 pages were proactively swapped "
+            "to the host tier under KV pressure (preemption avoided)",
+            registry=self.registry,
+        )
+        self.kv_swap_ins = Counter(
+            "dynamo_kv_swap_ins_total",
+            "Proactively offloaded rows restored to full residency "
+            "(token-identical resume from host-tier bytes)",
+            registry=self.registry,
+        )
         # Fault-tolerance counters (docs/fault_tolerance.md): retries and
         # failovers on the request plane, circuit-breaker churn, requests
         # abandoned at their deadline per stage, and drain lifecycle.

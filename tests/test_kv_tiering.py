@@ -1,0 +1,537 @@
+"""Predictive KV tiering tests (docs/engine_perf.md "Predictive KV
+tiering"): footprint-packed admission, the CopyStream's G2→G1 prefetch
+direction, and proactive cold-tail offload — swap instead of preempt.
+
+The identity proofs follow the test_overload pattern: one request alone
+never stalls (so a sequential re-run on the same engine is its own
+ample-resource oracle), and counter-based sampling makes tokens a pure
+function of the request, not the pool — so tiering on vs off must be
+token-identical by construction. The autouse conservation guard
+(tests/conftest.py) polices the page ledger across every scenario here;
+the chaos-marked sweep re-runs the 8x-pool identity run under the
+``make chaos`` seed sets.
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.engine import EngineConfig, HostKvPool, TPUEngine
+from dynamo_exp_tpu.engine.kv_manager import KvPageManager
+from dynamo_exp_tpu.engine.offload import CopyStream
+from dynamo_exp_tpu.engine.scheduler import Scheduler, Sequence, SeqState
+from dynamo_exp_tpu.engine.tiering import (
+    footprint_pages,
+    plan_swap_entries,
+    select_packed_index,
+    swap_tail_key,
+)
+from dynamo_exp_tpu.models import TINY
+from dynamo_exp_tpu.parallel import single_device_mesh
+from dynamo_exp_tpu.protocols.common import BackendInput, SamplingOptions
+from dynamo_exp_tpu.tokens import compute_block_hashes_for_seq
+
+PS = 8
+
+CHAOS_SEEDS = [
+    int(s) for s in os.environ.get("CHAOS_SEEDS", "7").split(",")
+]
+
+
+# ------------------------------------------------------------- pure units
+def test_footprint_pages():
+    # prompt + budget - 1 written positions, ceil to pages.
+    assert footprint_pages(8, 8, 8) == 2  # 15 tokens -> 2 pages
+    assert footprint_pages(8, 1, 8) == 1
+    assert footprint_pages(1, 1, 8) == 1
+    assert footprint_pages(16, 17, 8) == 4  # 32 tokens -> 4 pages
+    # max_model_len caps the forecast.
+    assert footprint_pages(8, 1000, 8, max_model_len=64) == 8
+
+
+def test_swap_tail_key_lives_outside_chain_hash_space():
+    block = list(range(PS))
+    chain = compute_block_hashes_for_seq(block, PS)
+    assert swap_tail_key(None, block) != chain[0]
+    # Deterministic, parent-sensitive.
+    assert swap_tail_key(None, block) == swap_tail_key(None, block)
+    assert swap_tail_key(7, block) != swap_tail_key(8, block)
+
+
+def test_select_packed_index_first_fit_and_packing():
+    # Head fits -> head (plain FIFO preserved).
+    assert select_packed_index([(True, 1, 0), (True, 1, 0)], 64) == 0
+    # Oversize head defers behind a smaller fit.
+    assert select_packed_index([(False, 1, 0), (True, 1, 0)], 64) == 1
+    # Nothing fits -> None (caller falls back to first-fit head).
+    assert select_packed_index([(False, 1, 0), (False, 1, 0)], 64) is None
+
+
+def test_select_packed_index_priority_guard():
+    # A lower-priority candidate may not bypass a deferred higher-
+    # priority head (no priority inversion through packing).
+    assert select_packed_index([(False, 2, 0), (True, 1, 0)], 64) is None
+    # Equal or higher priority may.
+    assert select_packed_index([(False, 1, 0), (True, 2, 0)], 64) == 1
+    assert select_packed_index([(False, 1, 0), (True, 1, 0)], 64) == 1
+
+
+def test_select_packed_index_starvation_barrier():
+    # A sequence bypassed max_defers times becomes a barrier: nothing
+    # behind it is considered.
+    assert select_packed_index([(False, 1, 3), (True, 1, 0)], 3) is None
+    assert select_packed_index([(False, 1, 2), (True, 1, 0)], 3) == 1
+
+
+def test_plan_swap_entries_classification():
+    # 4 pages: [shared, registered, unregistered-full, partial tail],
+    # plus one unwritten growth page.
+    tokens = list(range(3 * PS + 3))  # written = len-1 = 26
+    page_ids = [10, 11, 12, 13, 14]
+    refs = {10: 2, 11: 1, 12: 1, 13: 1, 14: 1}
+    hashes = {11: 999}
+    entries, off_pids, off_keys, park, drop = plan_swap_entries(
+        page_ids, tokens, PS, lambda p: refs[p], lambda p: hashes.get(p)
+    )
+    assert entries[0] == ("kept", 10)
+    assert entries[1] == ("hash", 999) and park == [11]
+    kinds = [k for k, _ in entries]
+    assert kinds == ["kept", "hash", "host", "host"]
+    assert off_pids == [12, 13] and len(off_keys) == 2
+    # The unregistered FULL page writes back under its true chain hash
+    # (matchable by other prompts); the partial tail under the tagged
+    # swap key.
+    chain = compute_block_hashes_for_seq(tokens[: 3 * PS], PS)
+    assert off_keys[0] == chain[2]
+    assert off_keys[1] == swap_tail_key(chain[2], tokens[3 * PS : 26])
+    assert drop == [14]  # page with no written KV just drops
+
+
+# ------------------------------------------------- scheduler-level packing
+def _mk_sched(num_pages=8, kv_packing=True, **cfg_kw):
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=4,
+        page_size=PS,
+        num_pages=num_pages,
+        max_model_len=512,
+        eos_token_ids=[],
+        kv_packing=kv_packing,
+        **cfg_kw,
+    )
+    kv = KvPageManager(num_pages, PS)
+    return Scheduler(cfg, kv)
+
+
+def _mk_seq(rid, prompt_len, max_tokens, priority=1):
+    prompt = list(range(1, prompt_len + 1))
+    stop = BackendInput(token_ids=list(prompt))
+    stop.stop_conditions.max_tokens = max_tokens
+    return Sequence(
+        request_id=rid,
+        prompt=prompt,
+        stop=stop,
+        emit=lambda *a, **k: None,
+        is_cancelled=lambda: False,
+        priority=priority,
+        submitted_at=time.time(),
+    )
+
+
+def test_packing_admits_small_fit_past_oversize_head():
+    sched = _mk_sched(num_pages=8)
+    big = _mk_seq("big", 24, 400)  # forecast ~53 pages >> 8
+    small = _mk_seq("small", 8, 8)  # forecast 2 pages
+    sched.submit(big)
+    sched.submit(small)
+    admitted = sched.admit_next()
+    assert admitted is small
+    assert big.packing_defers == 1
+    assert big.state is SeqState.WAITING
+
+
+def test_first_fit_without_packing_admits_the_head():
+    sched = _mk_sched(num_pages=8, kv_packing=False)
+    big = _mk_seq("big", 24, 400)
+    small = _mk_seq("small", 8, 8)
+    sched.submit(big)
+    sched.submit(small)
+    # Old behavior: the oversize head admits first-fit (its prompt
+    # fits now; it will stall later).
+    assert sched.admit_next() is big
+
+
+def test_packing_never_bypasses_a_higher_priority_head():
+    sched = _mk_sched(num_pages=8)
+    big = _mk_seq("big", 24, 400, priority=2)
+    small = _mk_seq("small", 8, 8, priority=0)
+    sched.submit(big)
+    sched.submit(small)
+    # No inversion: the high-priority head keeps its first-fit slot.
+    assert sched.admit_next() is big
+
+
+def test_packing_preserves_fifo_when_everything_fits():
+    sched = _mk_sched(num_pages=64)
+    a = _mk_seq("a", 8, 8)
+    b = _mk_seq("b", 8, 8)
+    sched.submit(a)
+    sched.submit(b)
+    assert sched.admit_next() is a
+    assert sched.admit_next() is b
+    assert a.packing_defers == b.packing_defers == 0
+
+
+def test_packing_forecast_credits_resident_prefix():
+    # A "big" prompt whose pages are already resident forecasts small.
+    sched = _mk_sched(num_pages=8)
+    first = _mk_seq("first", 3 * PS, 2)
+    sched.submit(first)
+    assert sched.admit_next() is first
+    first.tokens = list(first.prompt)
+    sched.register_full_pages(first)
+    fc = sched.forecast.forecast(_mk_seq("again", 3 * PS, 2))
+    # All 3 full prompt pages registered at allocation (pending-fill
+    # sharing) — the forecast credits them all.
+    assert fc.resident_pages == 3
+    assert fc.fresh_pages == fc.total_pages - 3
+
+
+# --------------------------------------------- CopyStream fetch direction
+def test_copy_stream_fetch_direction_drain_and_stop():
+    pool = HostKvPool(4, page_shape=(1, 2, 1, 2), dtype=np.float32)
+    a = np.ones((1, 2, 1, 2), np.float32)
+    pool.store(1, a, a * 2)
+    pool.store(2, a * 3, a * 4)
+    stream = CopyStream(pool)
+    results = []
+    ok = stream.fetch_batch(
+        [1, 2, 99], {"tag": "job"}, lambda ctx, fetched: results.append(
+            (ctx, fetched)
+        ),
+    )
+    assert ok
+    stream.drain()  # drain covers the fetch direction
+    assert len(results) == 1
+    ctx, fetched = results[0]
+    assert ctx == {"tag": "job"}
+    # Stops at the first miss (hash 99): chain-contiguous prefix only.
+    assert [h for h, _, _ in fetched] == [1, 2]
+    np.testing.assert_array_equal(fetched[0][1], a)
+    np.testing.assert_array_equal(fetched[1][2], a * 4)
+    # stop() stays bounded with BOTH directions queued.
+    stream.fetch_batch([1], None, lambda *a: None)
+    stream.offload_batch([5], a[None], a[None])
+    t0 = time.monotonic()
+    stream.stop()
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_copy_stream_offload_batch_reports_saturation():
+    pool = HostKvPool(2, page_shape=(1, 2, 1, 2), dtype=np.float32)
+    stream = CopyStream(pool, max_inflight=1)
+    stream.stop()  # worker gone: nothing drains the queue anymore
+    a = np.ones((1, 1, 2, 1, 2), np.float32)
+    assert stream.offload_batch([1], a, a) is True  # fills the queue
+    assert stream.offload_batch([2], a, a) is False  # saturated -> shed
+    assert stream.fetch_batch([2], None, lambda *a: None) is False
+    assert stream.dropped == 2
+
+
+# ------------------------------------------------------------ engine e2e
+def _engine(num_pages, host_pages, grace=0.5, slots=4, max_model_len=256,
+            **cfg_kw):
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=slots,
+        page_size=PS,
+        num_pages=num_pages,
+        max_model_len=max_model_len,
+        eos_token_ids=[],
+        host_cache_pages=host_pages,
+        kv_dtype="float32",  # bit-exact across offload round-trips
+        preempt_stall_grace_s=grace,
+        **cfg_kw,
+    )
+    return TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+
+
+async def _run(eng, prompt, max_tokens, priority=1, **sampling):
+    b = BackendInput(token_ids=list(prompt), priority=priority)
+    b.stop_conditions.max_tokens = max_tokens
+    b.stop_conditions.ignore_eos = True
+    if sampling:
+        b.sampling_options = SamplingOptions(**sampling)
+    stream = await eng.generate(b.to_dict())
+    tokens = []
+    async for item in stream:
+        tokens.extend(item.get("token_ids", []))
+    return tokens
+
+
+P1 = [5, 9, 17, 23, 4, 31, 8, 2]
+P2 = [7, 3, 19, 28, 41, 13, 6, 11]
+N = 40
+
+
+def test_proactive_offload_beats_preemption_greedy():
+    """The PR 5 pressure harness shape (two 8-token prompts decoding 40
+    tokens each on an 8-page pool — guaranteed KV pressure) — but with
+    a host tier: the engine swaps the cold row's bytes out instead of
+    preempting, and both streams stay token-identical to sequential
+    (ample-resource) oracle runs."""
+    eng = _engine(num_pages=8, host_pages=64)
+    eng.start()
+    try:
+        async def burst():
+            return await asyncio.gather(_run(eng, P1, N), _run(eng, P2, N))
+
+        t1, t2 = asyncio.run(burst())
+        assert len(t1) == N and len(t2) == N
+        assert eng.preempted == 0  # preemption was the policy; now fallback
+        assert eng.proactive_offloads > 0
+        assert eng.swap_ins > 0
+        # Sequential oracle on the same engine: one request alone never
+        # stalls, so no tiering machinery engages.
+        o1 = asyncio.run(_run(eng, P1, N))
+        o2 = asyncio.run(_run(eng, P2, N))
+        assert t1 == o1 and t2 == o2
+        audit = eng.kv_audit()
+        assert audit["ok"], audit["violations"]
+    finally:
+        eng.stop()
+
+
+def test_proactive_offload_identity_seeded_and_penalized():
+    eng = _engine(num_pages=8, host_pages=64)
+    eng.start()
+    sampling = dict(
+        temperature=0.8, top_k=20, seed=1234, frequency_penalty=0.3
+    )
+    try:
+        async def burst():
+            return await asyncio.gather(
+                _run(eng, P1, N, **sampling),
+                _run(eng, P2, N, **dict(sampling, seed=77)),
+            )
+
+        t1, t2 = asyncio.run(burst())
+        assert eng.preempted == 0 and eng.proactive_offloads > 0
+        o1 = asyncio.run(_run(eng, P1, N, **sampling))
+        o2 = asyncio.run(_run(eng, P2, N, **dict(sampling, seed=77)))
+        assert t1 == o1 and t2 == o2
+    finally:
+        eng.stop()
+
+
+def test_swap_miss_falls_back_to_preemption():
+    """A host tier too small to keep the swapped bytes: the swap-in
+    fetch misses, the row preempts (deterministic continuation), and
+    the stream still completes token-identically."""
+    eng = _engine(num_pages=8, host_pages=2)
+    eng.start()
+    try:
+        async def burst():
+            return await asyncio.gather(_run(eng, P1, N), _run(eng, P2, N))
+
+        t1, t2 = asyncio.run(burst())
+        assert len(t1) == N and len(t2) == N
+        o1 = asyncio.run(_run(eng, P1, N))
+        o2 = asyncio.run(_run(eng, P2, N))
+        assert t1 == o1 and t2 == o2
+    finally:
+        eng.stop()
+
+
+def test_prefetch_restores_ahead_of_admission_with_flight_proof():
+    """G2→G1 prefetch end to end: a prompt whose pages were evicted to
+    the host tier re-arrives while every slot is busy; the engine
+    restores its prefix BEFORE a slot frees (flight-ring ordering:
+    the prefetch inject dispatch lands between ragged dispatches and
+    before the target's admit event), and the admission then plain
+    G1-hits the restored pages."""
+    rs = np.random.RandomState(3)
+    pool = 24
+    eng = _engine(
+        num_pages=pool, host_pages=64, slots=2, max_model_len=pool * PS,
+        prefetch_reserve_pages=2,
+    )
+    eng.start()
+    try:
+        pa = [int(x) for x in rs.randint(3, 200, size=3 * PS + 2)]
+        # Phase 1: A runs and parks its 3 registered prompt pages.
+        a_tokens = asyncio.run(_run(eng, pa, 6))
+        # Phase 2: B consumes the whole pool, evicting A's parked pages
+        # into the host tier.
+        pb = [int(x) for x in rs.randint(3, 200, size=pool * PS - 6)]
+        asyncio.run(_run(eng, pb, 2))
+        assert eng.host_pool.resident >= 3
+        assert eng.kv.match_resident_hashes(
+            compute_block_hashes_for_seq(pa, PS)
+        ) == []
+        if eng.flight is not None:
+            eng.flight.clear()
+
+        # Phase 3: both slots busy with long decodes; A re-arrives and
+        # must WAIT — the window where prefetch beats admission.
+        async def scenario():
+            longs = [
+                asyncio.ensure_future(
+                    _run(eng, [int(x) for x in rs.randint(3, 200, size=PS)], N)
+                )
+                for _ in range(2)
+            ]
+            # Let the decoders actually occupy the slots.
+            steps0 = eng.steps
+            while eng.steps < steps0 + eng.cfg.decode_window:
+                await asyncio.sleep(0.005)
+            late = asyncio.ensure_future(_run(eng, pa, 6))
+            return await asyncio.gather(*longs, late)
+
+        *_, a2_tokens = asyncio.run(scenario())
+        assert a2_tokens == a_tokens  # restored bytes decode identically
+        m = eng.metrics()
+        assert m["kv_prefetch_pages"] >= 3
+        assert m["kv_prefetch_hits"] >= 3
+        # Flight-ring overlap proof: restore dispatched before the
+        # consuming admission, with compute dispatches around it.
+        events = eng.flight.snapshot()
+        prefetch_i = [
+            i
+            for i, e in enumerate(events)
+            if e["kind"] == "dispatch" and e.get("op") == "prefetch"
+        ]
+        admit_i = [
+            i
+            for i, e in enumerate(events)
+            if e["kind"] == "admit" and e.get("cached", 0) >= 3 * PS - 1
+        ]
+        assert prefetch_i, "no prefetch inject dispatch in the flight ring"
+        assert admit_i and prefetch_i[0] < admit_i[-1]
+        ragged_i = [
+            i
+            for i, e in enumerate(events)
+            if e["kind"] == "dispatch" and e.get("dispatch") == "ragged"
+        ]
+        # Restore overlapped compute: ragged dispatches both before and
+        # after the prefetch inject.
+        assert any(i < prefetch_i[0] for i in ragged_i)
+        assert any(i > prefetch_i[0] for i in ragged_i)
+    finally:
+        eng.stop()
+
+
+def test_stop_bounded_with_prefetch_in_flight():
+    eng = _engine(num_pages=16, host_pages=32, slots=2)
+    eng.start()
+    try:
+        asyncio.run(_run(eng, P1 * 3, 4))
+    finally:
+        t0 = time.monotonic()
+        eng.stop()
+        assert time.monotonic() - t0 < 30.0
+    # Stop returned every prefetch reservation (no lease left behind).
+    assert eng.kv.active_leases == 0
+
+
+# ----------------------------------------------- 8x-pool aggregate context
+def _aggregate_run(eng, seed, n_req=8, gen=56):
+    rs = np.random.RandomState(seed)
+    prompts = [
+        [int(x) for x in rs.randint(3, 200, size=PS)] for _ in range(n_req)
+    ]
+
+    async def burst():
+        return await asyncio.gather(
+            *[_run(eng, p, gen) for p in prompts]
+        )
+
+    tokens = asyncio.run(burst())
+    return prompts, tokens
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_identity_at_8x_pool(seed):
+    """Aggregate context = 8x the page pool (8 requests x 64 tokens on
+    an 8-page/64-token pool): predictive tiering absorbs the pressure
+    through swaps, every stream is token-identical to its sequential
+    oracle, and the conservation auditor stays green throughout (the
+    autouse guard polices the in-loop check; the final audit is
+    asserted explicitly). Two slots: a resident pair rotates via
+    swaps while the queue drains — four 8-page footprints sharing an
+    8-page pool would thrash at page granularity (minutes of rotation
+    for no extra coverage)."""
+    eng = _engine(num_pages=8, host_pages=128, slots=2)
+    eng.start()
+    try:
+        prompts, tokens = _aggregate_run(eng, seed)
+        assert all(len(t) == 56 for t in tokens)
+        assert eng.proactive_offloads > 0
+        # Preemption is the fallback, not the policy: a healthy tiered
+        # run keeps it at (or near) zero where the reactive engine
+        # preempted routinely.
+        assert eng.preempted <= 1
+        for p, t in zip(prompts, tokens):
+            assert asyncio.run(_run(eng, p, 56)) == t
+        audit = eng.kv_audit()
+        assert audit["ok"], audit["violations"]
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------------------- sim
+@pytest.mark.sim
+def test_sim_proactive_offload_reduces_preemptions():
+    """The same policy in the cluster simulator: at the pressure-
+    harness shape, a modeled host tier turns preemptions into
+    proactive offloads at equal-or-better completion."""
+    from dynamo_exp_tpu.sim import ClusterSim, SimConfig, burst_workload
+
+    base = dict(
+        seed=7,
+        slots_per_instance=4,
+        pages_per_instance=8,
+        page_size=8,
+        preempt_stall_grace_s=0.05,
+        max_inflight=16,
+        shed_watermark=12,
+        initial_instances=1,
+    )
+    reactive = ClusterSim(
+        SimConfig(**base, host_pages_per_instance=0),
+        burst_workload(7, n=8, osl_range=(6, 12)),
+    ).run()
+    tiered = ClusterSim(
+        SimConfig(**base, host_pages_per_instance=64),
+        burst_workload(7, n=8, osl_range=(6, 12)),
+    ).run()
+    assert tiered.proactive_offloads > 0
+    assert tiered.preemptions < max(reactive.preemptions, 1)
+    assert tiered.completed >= reactive.completed
+
+
+@pytest.mark.sim
+def test_sim_packing_is_deterministic():
+    from dynamo_exp_tpu.sim import ClusterSim, SimConfig, burst_workload
+
+    def run():
+        sim = ClusterSim(
+            SimConfig(
+                seed=21,
+                slots_per_instance=4,
+                pages_per_instance=8,
+                page_size=8,
+                host_pages_per_instance=32,
+            ),
+            burst_workload(21, n=8, osl_range=(6, 12)),
+        )
+        rep = sim.run()
+        return sim.event_log, rep.to_dict()
+
+    log1, rep1 = run()
+    log2, rep2 = run()
+    assert log1 == log2 and rep1 == rep2
