@@ -27,7 +27,7 @@ SPEC_SEED_SETS := 7,21,1337
 # conservation-audited) in tests/test_kv_tiering.py.
 TIERING_SEED_SETS := 7,21,1337 3,9,27
 
-.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare
+.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare anatomy-smoke
 
 test:
 	$(PYTEST) tests/ -q -m "not tpu and not weekly"
@@ -122,3 +122,20 @@ bench-compare:
 	set -- $$files; \
 	if [ $$# -lt 2 ]; then echo "fewer than two BENCH_r*.json files; nothing to compare"; exit 0; fi; \
 	python -m dynamo_exp_tpu.llmctl bench compare $$1 $$2
+
+# Request-anatomy + workload-fingerprint smoke (docs/observability.md
+# "Request anatomy" / "Workload fingerprint"): decompose every trace in
+# the checked-in fixture (`--why` waterfalls must render, components
+# summing to the edge latency), list the worst-N, and fingerprint the
+# fixture — the digest is deterministic, so it is pinned here and in
+# tests/test_anatomy.py; a bucketing or hashing change must touch both.
+# Runs pre-merge (pre-merge.yml).
+anatomy-smoke:
+	env JAX_PLATFORMS=cpu python -m dynamo_exp_tpu.llmctl trace aaaa1111 \
+		--trace-file tests/fixtures/anatomy_trace.jsonl --why
+	env JAX_PLATFORMS=cpu python -m dynamo_exp_tpu.llmctl trace bbbb2222 \
+		--trace-file tests/fixtures/anatomy_trace.jsonl --why
+	env JAX_PLATFORMS=cpu python -m dynamo_exp_tpu.llmctl slow \
+		--trace-file tests/fixtures/anatomy_trace.jsonl -n 5
+	env JAX_PLATFORMS=cpu python -m dynamo_exp_tpu.llmctl fingerprint \
+		tests/fixtures/anatomy_trace.jsonl

@@ -134,6 +134,21 @@ def _dispatch_stats(engine) -> dict:
     }
 
 
+def _anatomy_stats(engine) -> dict:
+    """Mean per-request latency anatomy (telemetry/anatomy.py component
+    seconds over finished requests) attached to every bench JSON line,
+    so ``llmctl bench compare`` can attribute a throughput regression
+    to the component that moved (queue vs prefill vs decode vs swap
+    stall) instead of just flagging the headline number. Zero-valued
+    components are dropped to keep bench lines compact."""
+    m = engine.metrics()
+    n = m.get("anatomy_requests") or 0
+    totals = m.get("anatomy_totals") or {}
+    if not n:
+        return {}
+    return {comp: round(sec / n, 6) for comp, sec in totals.items() if sec}
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache (docs/aot.md): repeat bench
     runs (and the driver's end-of-round run) skip the 20-40s
@@ -241,6 +256,7 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
     tok_s, p50_ttft = asyncio.run(burst())
     roofline = _roofline_tok_s(engine.params, concurrency)
     dispatch = _dispatch_stats(engine)
+    anatomy = _anatomy_stats(engine)
     engine.stop()
     return {
         "metric": f"decode_throughput_{MODEL}_isl{isl}_osl{osl}_c{concurrency}",
@@ -250,6 +266,7 @@ def run_point(isl: int, osl: int, concurrency: int) -> dict:
         "p50_ttft_s": round(p50_ttft, 3),
         "decode_window": engine.cfg.decode_window,
         "dispatch": dispatch,
+        "anatomy": anatomy,
     }
 
 
@@ -340,6 +357,7 @@ def run_occupancy_sweep(
                 "kv_page_moves": engine.kv_page_moves - moves0,
                 "decode_window": engine.cfg.decode_window,
                 "dispatch": _dispatch_stats(engine),
+                "anatomy": _anatomy_stats(engine),
             }
         )
 
@@ -445,6 +463,7 @@ def run_occupancy_sweep(
                 "compiled_ragged_variants": m["compiled_ragged_variants"],
                 "decode_window": engine.cfg.decode_window,
                 "dispatch": disp,
+                "anatomy": _anatomy_stats(engine),
             }
         )
     engine.stop()
@@ -528,6 +547,7 @@ def run_occupancy_sweep(
                 "p99_itl_s": round(p99_itl, 4) if p99_itl is not None else None,
                 "decode_window": peng.cfg.decode_window,
                 "dispatch": _dispatch_stats(peng),
+                "anatomy": _anatomy_stats(peng),
             }
         )
         peng.stop()
@@ -644,6 +664,7 @@ def run_overload_sweep(
             "preemptions": engine.preempted - preempted0,
             "decode_window": engine.cfg.decode_window,
             "dispatch": _dispatch_stats(engine),
+            "anatomy": _anatomy_stats(engine),
         }
 
     out = []
@@ -807,6 +828,7 @@ def run_spec_sweep(
                     else None,
                     "decode_window": engine.cfg.decode_window,
                     "dispatch": _dispatch_stats(engine),
+                    "anatomy": _anatomy_stats(engine),
                 }
             )
             engine.stop()
@@ -888,6 +910,7 @@ def run_prefix_reuse(isl: int = 1024, osl: int = 16, concurrency: int = 8) -> di
         "p50_ttft_warm_s": round(p50(warm), 3),
         "decode_window": engine.cfg.decode_window,
         "dispatch": _dispatch_stats(engine),
+        "anatomy": _anatomy_stats(engine),
     }
 
 
@@ -1027,6 +1050,7 @@ def run_prefix_sweep(
                 "private": private,
                 "decode_window": shared_eng.cfg.decode_window,
                 "dispatch": _dispatch_stats(shared_eng),
+                "anatomy": _anatomy_stats(shared_eng),
             }
         )
     shared_eng.stop()
@@ -1191,6 +1215,7 @@ def run_coldstart_sweep(
             "ragged_compile_total_s": disp["compile_total_s"],
             "decode_window": engine.cfg.decode_window,
             "dispatch": _dispatch_stats(engine),
+            "anatomy": _anatomy_stats(engine),
         }
         engine.stop()
         return point

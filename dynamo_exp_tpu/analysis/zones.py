@@ -65,6 +65,15 @@ DETERMINISM_ZONES: tuple[Zone, ...] = (
     # hosts. The prewarm/compile timing metrics are the only sanctioned
     # wall-clock reads (inline-waived: "prewarm wall-clock metric").
     Zone("dynamo_exp_tpu/aot/"),
+    # The request-anatomy plane (docs/observability.md "Request
+    # anatomy"): decompositions are assembled from recorded spans /
+    # flight events / accumulated timings — pure arithmetic, so the
+    # same trace must always yield the same waterfall. The workload
+    # fingerprint digest doubles as a comparison key across runs and
+    # hosts, so bucketing and hashing must be free of wall-clock /
+    # id() / dict-order effects.
+    Zone("dynamo_exp_tpu/telemetry/anatomy.py"),
+    Zone("dynamo_exp_tpu/telemetry/fingerprint.py"),
 )
 
 # ------------------------------------------------- thread-ownership model
@@ -136,6 +145,14 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "prefetch_late",
                 "proactive_offloads",
                 "swap_ins",
+                # Request anatomy plane (docs/observability.md "Request
+                # anatomy"): component totals and the finished-request
+                # count are accumulated in the scheduler's finish
+                # callback on the loop; metrics() reads them cross-
+                # thread as monotonic GIL-atomic snapshots, same
+                # contract as `steps`/`preempted` above.
+                "anatomy_totals",
+                "anatomy_requests",
             }
         ),
         handoff=frozenset(
@@ -158,6 +175,9 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "host_pool",
                 "flight",
                 "profiler",
+                "anatomy_ring",  # worst-N exemplars, internally locked
+                "fingerprint",  # workload digest builder, internally locked
+                "drift_watch",  # reads fingerprint snapshots only
                 "cfg",
                 "mesh",
                 "_seed_rng",  # submission-side only (asyncio threads)
@@ -210,6 +230,41 @@ LOCK_MANIFESTS: tuple[LockManifest, ...] = (
                 "completed",
                 "violations",
                 "goodput_by_priority",
+                "_burn",  # multi-window burn-rate deques
+            }
+        ),
+    ),
+    LockManifest(
+        # Worst-N anatomy exemplars: offered from the engine loop's
+        # finish callback, snapshotted by /metrics scrapes and
+        # `llmctl slow` — every ring access sits under the lock.
+        path="dynamo_exp_tpu/telemetry/anatomy.py",
+        cls="AnatomyRing",
+        lock="_lock",
+        guarded=frozenset({"_worst"}),
+    ),
+    LockManifest(
+        # Online workload fingerprint: admissions observed on the
+        # engine loop, snapshots taken from /metrics scrapes and the
+        # drift watch — all histogram state sits under the lock.
+        path="dynamo_exp_tpu/telemetry/fingerprint.py",
+        cls="FingerprintBuilder",
+        lock="_lock",
+        guarded=frozenset(
+            {
+                "_n",
+                "_isl",
+                "_osl",
+                "_prio",
+                "_prompt_tokens",
+                "_cached_tokens",
+                "_spec_sum",
+                "_spec_n",
+                "_first_t",
+                "_last_t",
+                "_ia_n",
+                "_ia_mean",
+                "_ia_m2",
             }
         ),
     ),

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import queue
 import random
 import threading
@@ -81,7 +82,13 @@ from ..protocols.common import BackendInput, FinishReason, LLMEngineOutput
 from ..runtime.engine import AsyncEngine, AsyncEngineContext, ResponseStream
 from ..telemetry import current_trace, get_telemetry
 from ..tokens import compute_block_hashes_for_seq
+from ..telemetry.anatomy import COMPONENTS, AnatomyRing, anatomy_from_timing
 from ..telemetry.dispatch import DispatchProfiler
+from ..telemetry.fingerprint import (
+    FingerprintBuilder,
+    WorkloadDriftWatch,
+    load_fingerprint,
+)
 from ..telemetry.flight import (
     FlightRecorder,
     Watchdog,
@@ -450,6 +457,30 @@ class TPUEngine(AsyncEngine):
         self.prefetch_late = 0
         self.proactive_offloads = 0
         self.swap_ins = 0
+        # Request anatomy + workload fingerprint plane
+        # (docs/observability.md "Request anatomy"): per-component
+        # latency totals (loop-written, mirrored by metrics()), the
+        # bounded worst-N exemplar ring behind `llmctl slow`, the live
+        # workload fingerprint builder (fed at admission/finish), and
+        # the drift watch against an optionally pinned reference
+        # fingerprint (DYN_WORKLOAD_REF=<fingerprint.json>).
+        self.anatomy_totals: dict[str, float] = dict.fromkeys(
+            COMPONENTS, 0.0
+        )
+        self.anatomy_requests = 0
+        self.anatomy_ring = AnatomyRing(
+            capacity=int(os.environ.get("DYN_ANATOMY_RING", "16") or 16)
+        )
+        self.fingerprint = FingerprintBuilder()
+        ref = None
+        ref_path = os.environ.get("DYN_WORKLOAD_REF", "")
+        if ref_path:
+            try:
+                ref = load_fingerprint(ref_path)
+            except (OSError, ValueError) as e:
+                log.warning("DYN_WORKLOAD_REF unreadable (%s): %s", ref_path, e)
+        self.drift_watch = WorkloadDriftWatch(self.fingerprint, ref)
+        self.sched.on_finish = self._record_anatomy
         # Fleet build-info (docs/observability.md "Fleet plane"): the
         # AOT lattice manifest hash + jax version + feature flags, so
         # fleet scrapes can detect config skew between instances.
@@ -1328,6 +1359,27 @@ class TPUEngine(AsyncEngine):
         captured at submission."""
         now = time.time()
         seq.admitted_at = now
+        # Anatomy (telemetry/anatomy.py): a first admission closes the
+        # queue-wait segment; a re-admission after preemption closes
+        # the preemption-limbo segment instead. The profiler's
+        # compile-seconds total is marked here so _finish_first_token
+        # can attribute the delta as this request's compile stall. The
+        # workload fingerprint counts every first admission (a
+        # preemption continuation is the same request, not new load).
+        if seq.anat_preempted_at:
+            seq.anat_preempt_s += max(now - seq.anat_preempted_at, 0.0)
+            seq.anat_preempted_at = 0.0
+        else:
+            if seq.submitted_at:
+                seq.anat_queue_s += max(now - seq.submitted_at, 0.0)
+            self.fingerprint.observe_admit(
+                len(seq.prompt),
+                seq.cached_len,
+                seq.priority,
+                seq.submitted_at or now,
+            )
+        if self.profiler is not None:
+            seq.anat_compile_mark = self.profiler.compile_total_s()
         self._note_prefetch_admission(seq)
         if self.flight is not None:
             self.flight.record(
@@ -1348,6 +1400,82 @@ class TPUEngine(AsyncEngine):
                 seq.trace,
                 prompt_tokens=len(seq.prompt),
             )
+
+    # ------------------------------------------------------ request anatomy
+    def _record_anatomy(self, seq: Sequence, reason, now: float, was_bound: bool) -> None:
+        """Scheduler ``on_finish`` tap (docs/observability.md "Request
+        anatomy"): close the sequence's open anatomy segments, assemble
+        the decomposition from its loop-stamped accumulators (pure
+        arithmetic — no device work, no new host syncs), and feed the
+        per-component totals, the worst-N exemplar ring, and the
+        workload fingerprint. Extract-mode sequences (disagg prefill
+        workers) are internal sub-requests and skipped — their time
+        shows up in the client request's remote_prefill/transfer
+        spans."""
+        if seq.extract_cb is not None:
+            return
+        if seq.anat_preempted_at:
+            # Finished while in preemption limbo (e.g. cancelled from
+            # the waiting deque): the requeue wait is preemption cost.
+            seq.anat_preempt_s += max(now - seq.anat_preempted_at, 0.0)
+            seq.anat_preempted_at = 0.0
+        elif was_bound:
+            if seq.first_token_at:
+                seq.anat_decode_s += max(now - seq.first_token_at, 0.0)
+            elif seq.admitted_at:
+                seq.anat_prefill_s += max(now - seq.admitted_at, 0.0)
+            if seq.swapped_since:
+                seq.anat_swap_s += max(now - seq.swapped_since, 0.0)
+            elif seq.stalled_since:
+                seq.anat_swap_s += max(now - seq.stalled_since, 0.0)
+            if seq.admitted_at:
+                seq.anat_page_s += len(seq.page_ids) * max(
+                    now - seq.admitted_at, 0.0
+                )
+        resumed = seq.stop.resume_offset or 0
+        generated = resumed + seq.generated
+        ttft = None
+        if seq.first_token_at and not seq.preemptions and seq.submitted_at:
+            ttft = max(seq.first_token_at - seq.submitted_at, 0.0)
+        itl = None
+        if seq.first_token_at and seq.generated > 1:
+            itl = max(now - seq.first_token_at, 0.0) / (seq.generated - 1)
+        a = anatomy_from_timing(
+            seq.request_id,
+            queue_s=seq.anat_queue_s,
+            prefill_s=seq.anat_prefill_s,
+            decode_s=seq.anat_decode_s,
+            compile_s=seq.anat_compile_s,
+            swap_s=seq.anat_swap_s,
+            preempt_s=seq.anat_preempt_s,
+            gap_frac=(
+                self.profiler.host_gap_fraction("ragged")
+                if self.profiler is not None
+                else 0.0
+            ),
+            edge_latency_s=max(now - seq.submitted_at, 0.0)
+            if seq.submitted_at
+            else 0.0,
+            ttft_s=ttft,
+            itl_s=itl,
+            prompt_tokens=max(len(seq.prompt) - resumed, 0),
+            generated_tokens=generated,
+            priority=seq.priority,
+            page_seconds=seq.anat_page_s,
+        )
+        self.anatomy_requests += 1
+        tel = get_telemetry()
+        for comp, v in a.components.items():
+            if v > 0:
+                self.anatomy_totals[comp] += v
+                tel.request_seconds.labels(comp).inc(v)
+        self.anatomy_ring.offer(a)
+        self.fingerprint.observe_finish(
+            generated,
+            round(seq.spec_emitted_tokens / seq.spec_dispatches, 4)
+            if seq.spec_dispatches
+            else 0.0,
+        )
 
     # --------------------------------------------------- flight / profiling
     def _decode_span_attrs(self) -> dict:
@@ -1739,7 +1867,13 @@ class TPUEngine(AsyncEngine):
         victim.swapped_since = time.time()
         victim.swaps += 1
         # A stalled victim is no longer starving — it is parked in the
-        # host tier (swap-in owns its liveness now).
+        # host tier (swap-in owns its liveness now). Its open stall
+        # window rolls into the anatomy swap/stall accumulator so the
+        # swap window (which starts now) doesn't double-count it.
+        if victim.stalled_since:
+            victim.anat_swap_s += max(
+                victim.swapped_since - victim.stalled_since, 0.0
+            )
         victim.stalled = False
         victim.stalled_since = 0.0
         self.proactive_offloads += 1
@@ -1865,6 +1999,9 @@ class TPUEngine(AsyncEngine):
                 )
             seq.page_ids = new_ids
             seq.swap = None
+            if seq.swapped_since:
+                # Anatomy: the swap window just closed.
+                seq.anat_swap_s += max(time.time() - seq.swapped_since, 0.0)
             seq.swapped_since = 0.0
             self.swap_ins += 1
             get_telemetry().kv_swap_ins.inc()
@@ -2254,6 +2391,17 @@ class TPUEngine(AsyncEngine):
         tel = get_telemetry()
         start = seq.admitted_at or seq.submitted_at or now
         tel.prefill_compute.observe(max(now - start, 0.0))
+        # Anatomy: close this life's prefill segment and attribute the
+        # profiler's compile-seconds growth since admission as this
+        # request's compile stall (clamped into prefill at assembly).
+        prefill_s = max(now - start, 0.0)
+        seq.anat_prefill_s += prefill_s
+        compile_s = 0.0
+        if self.profiler is not None:
+            compile_s = max(
+                self.profiler.compile_total_s() - seq.anat_compile_mark, 0.0
+            )
+            seq.anat_compile_s += min(compile_s, prefill_s)
         tel.emit_stage(
             "prefill",
             start,
@@ -2263,6 +2411,7 @@ class TPUEngine(AsyncEngine):
             cached_tokens=seq.cached_len,
             remote=seq.remote_prefilled or None,
             resumed_tokens=seq.stop.resume_offset or None,
+            compile_s=round(compile_s, 6) if compile_s else None,
             # Dispatch-profiler medians (sim/fit.py reads these).
             **(
                 self.profiler.span_attrs("ragged")
@@ -2270,6 +2419,10 @@ class TPUEngine(AsyncEngine):
                 else {}
             ),
         )
+        if self.flight is not None:
+            # Anatomy reconstruction from a flight dump alone needs the
+            # prefill/decode boundary (telemetry.anatomy.anatomy_from_flight).
+            self.flight.record("first_token", req=seq.request_id, slot=seq.slot)
         seq.state = SeqState.ACTIVE
         self._counts = self._init_row(self._counts, seq.slot, token)
         resumed = seq.stop.resume_offset or 0
@@ -2466,10 +2619,13 @@ class TPUEngine(AsyncEngine):
             seq.stalled = len(seq.page_ids) * ps < min(
                 wpos + K, cfg.max_model_len
             )
-            if seq.stalled_since and self.flight is not None:
-                self.flight.record(
-                    "stall_end", req=seq.request_id, slot=seq.slot
-                )
+            if seq.stalled_since:
+                # Anatomy: the page-stall window just closed.
+                seq.anat_swap_s += max(time.time() - seq.stalled_since, 0.0)
+                if self.flight is not None:
+                    self.flight.record(
+                        "stall_end", req=seq.request_id, slot=seq.slot
+                    )
             seq.stalled_since = 0.0  # progressing (even if window-capped)
             part = sampler if self._needs_sampler(seq) else greedy
             part.append((seq, wpos, cap))
@@ -3399,6 +3555,23 @@ class TPUEngine(AsyncEngine):
         # up across instances.
         m["kv_ledger_violations"] = self.kv_ledger_violations
         m["build_info"] = dict(self._build_info)
+        # Request anatomy + workload fingerprint plane
+        # (docs/observability.md "Request anatomy"): per-component
+        # latency totals over finished requests, the worst-N exemplar
+        # ring (`llmctl slow` reads this), the live workload
+        # fingerprint, the multi-window SLO burn rates, and the drift
+        # score vs the pinned reference (0.0 when none is pinned).
+        m["anatomy_totals"] = {
+            k: round(v, 6) for k, v in self.anatomy_totals.items()
+        }
+        m["anatomy_requests"] = self.anatomy_requests
+        m["anatomy_slow"] = self.anatomy_ring.snapshot()
+        fp = self.fingerprint.snapshot()
+        m["workload_fingerprint"] = fp.digest()
+        m["workload_requests"] = fp.n
+        drift = self.drift_watch.score()
+        m["workload_drift_score"] = drift
+        get_telemetry().workload_drift_score.set(drift)
         from ..telemetry.fleet import get_transfer_ledger
 
         m["kv_links"] = get_transfer_ledger().snapshot()

@@ -173,6 +173,27 @@ class Sequence:
     swap: "object | None" = None
     swapped_since: float = 0.0
     swaps: int = 0
+    # Request anatomy accumulators (telemetry/anatomy.py, docs/
+    # observability.md "Request anatomy"). All loop-stamped wall-time
+    # sums that survive preemption (preempt() resets decode state but
+    # never these), so the finish-time decomposition covers the
+    # request's whole life: first-admission queue wait, per-life
+    # prefill/decode wall, compile stall inside prefill, swap/stall
+    # windows inside decode, and preempt->re-admit requeue time.
+    # anat_compile_mark carries the profiler's compile-seconds total at
+    # admission; anat_preempted_at the wall time of the last preempt
+    # (0 = not currently preempted); anat_page_s the page-residency
+    # integral (final page count x slot-resident wall, accumulated at
+    # each preempt/finish).
+    anat_queue_s: float = 0.0
+    anat_prefill_s: float = 0.0
+    anat_decode_s: float = 0.0
+    anat_compile_s: float = 0.0
+    anat_swap_s: float = 0.0
+    anat_preempt_s: float = 0.0
+    anat_page_s: float = 0.0
+    anat_compile_mark: float = 0.0
+    anat_preempted_at: float = 0.0
 
     @property
     def pos(self) -> int:
@@ -224,6 +245,10 @@ class Scheduler:
         # Set by the engine: () -> dict of dispatch-profiler attrs to
         # attach to the decode span (sim/fit.py fits from them).
         self.span_attrs: Callable[[], dict] | None = None
+        # Set by the engine: (seq, reason, now, was_bound) -> None,
+        # called at finish before page release — the request-anatomy
+        # assembly tap (telemetry/anatomy.py).
+        self.on_finish: Callable | None = None
         # Footprint-packed admission (docs/engine_perf.md "Predictive
         # KV tiering"): None = plain first-fit FIFO.
         self.forecast = KvFootprintForecast(kv, cfg) if cfg.kv_packing else None
@@ -487,6 +512,7 @@ class Scheduler:
     def finish(self, seq: Sequence, reason: FinishReason) -> None:
         if seq.state == SeqState.FINISHED:
             return
+        now = time.time()
         was_bound = seq.state in (SeqState.PREFILL, SeqState.ACTIVE)
         if seq.first_token_at and seq.extract_cb is None:
             # Close the request's decode span (first token -> finish).
@@ -497,7 +523,7 @@ class Scheduler:
             get_telemetry().emit_stage(
                 "decode",
                 seq.first_token_at,
-                time.time(),
+                now,
                 seq.trace,
                 generated_tokens=seq.generated,
                 finish_reason=getattr(reason, "value", str(reason)),
@@ -505,6 +531,11 @@ class Scheduler:
                     round(seq.spec_emitted_tokens / seq.spec_dispatches, 4)
                     if seq.spec_dispatches
                     else None
+                ),
+                pages=len(seq.page_ids),
+                priority=seq.priority,
+                swap_stall_s=(
+                    round(seq.anat_swap_s, 6) if seq.anat_swap_s else None
                 ),
                 **(self.span_attrs() if self.span_attrs is not None else {}),
             )
@@ -515,7 +546,14 @@ class Scheduler:
                 slot=seq.slot if was_bound else None,
                 reason=getattr(reason, "value", str(reason)),
                 generated=seq.generated,
+                pages=len(seq.page_ids),
+                priority=seq.priority,
             )
+        # Anatomy hook (engine._record_anatomy): runs before page
+        # release so the page count is still real, with the same
+        # ``now`` the decode span closed on.
+        if self.on_finish is not None:
+            self.on_finish(seq, reason, now, was_bound)
         seq.state = SeqState.FINISHED
         if seq.slot >= 0 and was_bound:
             self.slots[seq.slot] = None
@@ -551,6 +589,24 @@ class Scheduler:
         just parked and starve the stalled rows the preemption was
         meant to feed."""
         k = seq.generated
+        now = time.time()
+        # Anatomy: close this life's decode segment and any open swap /
+        # stall window, book the page-residency integral for the pages
+        # about to be released, and mark preemption limbo — requeue
+        # time until re-admission (or finish) counts as ``preemption``.
+        if seq.first_token_at:
+            seq.anat_decode_s += max(now - seq.first_token_at, 0.0)
+        elif seq.admitted_at:
+            seq.anat_prefill_s += max(now - seq.admitted_at, 0.0)
+        if seq.swapped_since:
+            seq.anat_swap_s += max(now - seq.swapped_since, 0.0)
+        elif seq.stalled_since:
+            seq.anat_swap_s += max(now - seq.stalled_since, 0.0)
+        if seq.admitted_at:
+            seq.anat_page_s += len(seq.page_ids) * max(
+                now - seq.admitted_at, 0.0
+            )
+        seq.anat_preempted_at = now
         if self.flight is not None:
             self.flight.record(
                 "preempt",

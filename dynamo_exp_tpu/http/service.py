@@ -404,7 +404,7 @@ class HttpService:
                     root.set(status="error")
                     ctx.kill()
                     return _error_response(500, str(e))
-                self._record_slo(priority, t_arrival, lat)
+                self._record_slo(priority, t_arrival, lat, root)
                 return web.json_response(full.model_dump(exclude_none=True))
 
             resp = web.StreamResponse(
@@ -422,7 +422,7 @@ class HttpService:
                 # Attributed only on a fully drained stream: a request
                 # that errored or lost its client is not goodput and
                 # its truncated latencies would poison the window.
-                self._record_slo(priority, t_arrival, lat)
+                self._record_slo(priority, t_arrival, lat, root)
             except (ConnectionResetError, asyncio.CancelledError):
                 # Client went away: kill generation immediately.
                 logger.info("client disconnected; killing request %s", ctx.id)
@@ -440,21 +440,34 @@ class HttpService:
             await resp.write_eof()
             return resp
 
-    def _record_slo(self, priority: int, t_arrival: float, lat: dict) -> None:
+    def _record_slo(
+        self, priority: int, t_arrival: float, lat: dict, root=None
+    ) -> None:
         """Feed one completed request into the SLO attribution: TTFT =
         arrival -> first content chunk, ITL = mean inter-token interval
         after it (None for single-token responses — never a violation).
-        """
-        if self.slo is None or not lat["first"]:
+        The same edge measurements stamp the root http_request span
+        (``ttft_s`` / ``itl_s`` / ``latency_s``) — the ground truth the
+        request-anatomy component sum is checked against
+        (telemetry/anatomy.py, `llmctl trace --why`)."""
+        if not lat["first"]:
             return
+        ttft = max(lat["first"] - t_arrival, 0.0)
         itl = None
         if lat["tokens"] > 1:
             itl = max(lat["last"] - lat["first"], 0.0) / (lat["tokens"] - 1)
-        self.slo.record(
-            priority,
-            ttft_s=max(lat["first"] - t_arrival, 0.0),
-            itl_s=itl,
-        )
+        if root is not None:
+            root.set(
+                ttft_s=round(ttft, 6),
+                latency_s=round(max(lat["last"] - t_arrival, 0.0), 6),
+                tokens=lat["tokens"],
+                priority=priority,
+            )
+            if itl is not None:
+                root.set(itl_s=round(itl, 6))
+        if self.slo is None:
+            return
+        self.slo.record(priority, ttft_s=ttft, itl_s=itl)
 
 
 class _FanoutContext:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import re
 import sys
 
 from .local_model import MDC_BUCKET, MODELS_PREFIX, ModelEntry
@@ -194,6 +195,78 @@ def build_parser() -> argparse.ArgumentParser:
         help="recorder JSONL path(s); defaults to $DYN_TRACE_FILE "
              "(rotated generations are read automatically)",
     )
+    trace.add_argument(
+        "--why", action="store_true",
+        help="decompose the trace into latency components (request "
+             "anatomy waterfall) instead of the raw span timeline",
+    )
+
+    # Worst-N request listing (docs/observability.md "Request
+    # anatomy"): offline over a recorder span file, or live from every
+    # instance's bounded exemplar ring via the coordinator.
+    slow = sub.add_parser(
+        "slow", help="list the slowest requests with their dominant "
+                     "latency component",
+    )
+    slow.add_argument(
+        "--trace-file", action="append", default=None,
+        help="recorder JSONL path(s) for offline mode; defaults to "
+             "$DYN_TRACE_FILE; omit (and pass --coordinator) to scrape "
+             "the live fleet's exemplar rings",
+    )
+    slow.add_argument("-n", "--count", type=int, default=10)
+    slow.add_argument(
+        "--by", choices=("edge", "ttft", "itl"), default="edge",
+        help="sort key (default: edge latency)",
+    )
+    slow.add_argument(
+        "--why", action="store_true",
+        help="print the full anatomy waterfall per request, not just "
+             "the one-line summary",
+    )
+
+    # Workload fingerprint (docs/observability.md "Workload
+    # fingerprint"): characterize a recorded workload — span file, sim
+    # trace, or bench capture — as a deterministic hashable digest,
+    # optionally diffing against a pinned reference or replaying it
+    # into a sim workload trace.
+    fprint = sub.add_parser(
+        "fingerprint", help="characterize a workload from spans / trace "
+                            "/ bench files (offline)",
+    )
+    fprint.add_argument(
+        "path", help="span JSONL, sim workload trace, bench capture, or "
+                     "a saved fingerprint JSON",
+    )
+    fprint.add_argument(
+        "--kind", choices=("auto", "spans", "trace", "bench", "ref"),
+        default="auto",
+        help="input format (default: sniff from content)",
+    )
+    fprint.add_argument("--json", action="store_true",
+                        help="print the full fingerprint as JSON")
+    fprint.add_argument(
+        "--out", default="",
+        help="also write the fingerprint JSON here (pin it via "
+             "DYN_WORKLOAD_REF for the live drift watch)",
+    )
+    fprint.add_argument(
+        "--ref", default="",
+        help="reference fingerprint JSON to score drift against",
+    )
+    fprint.add_argument(
+        "--replay-out", default="",
+        help="write a sim workload trace drawn from the fingerprint "
+             "(the fingerprint->sim bridge; replay with "
+             "`llmctl sim users --trace-in FILE`)",
+    )
+    fprint.add_argument("--seed", type=int, default=0,
+                        help="seed for --replay-out draws")
+    fprint.add_argument(
+        "--requests", type=int, default=None,
+        help="request count for --replay-out (default: the "
+             "fingerprint's own n)",
+    )
 
     # Offline flight-dump rendering (docs/observability.md "Engine
     # flight recorder & watchdog"): a dump file holds one block per
@@ -211,6 +284,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--list", action="store_true",
         help="list the file's dump blocks instead of rendering one",
     )
+    flight.add_argument(
+        "--why", action="store_true",
+        help="reconstruct per-request latency anatomy from the dump's "
+             "admit/first_token/preempt/stall/finish events",
+    )
+    flight.add_argument(
+        "--req", default="",
+        help="with --why: only the given request id",
+    )
 
     # Live fleet dashboard (docs/observability.md "Fleet plane"):
     # scrape every discovered instance's stats plane into one rolled-up
@@ -226,6 +308,11 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument(
         "--once", action="store_true",
         help="print one snapshot and exit (no refresh loop)",
+    )
+    top.add_argument(
+        "--json", action="store_true",
+        help="print one machine-readable snapshot (rollup + per-"
+             "instance views) and exit; implies --once",
     )
 
     # Offline KV conservation audit rendering (docs/observability.md
@@ -418,8 +505,227 @@ def run_trace(args) -> int:
     if not group:
         print(f"no trace matching {args.trace_id!r}", file=sys.stderr)
         return 1
+    if getattr(args, "why", False):
+        from .telemetry import anatomy_from_spans, render_anatomy
+
+        anatomy = anatomy_from_spans(group)
+        if anatomy is None:
+            print("trace has no decomposable spans", file=sys.stderr)
+            return 1
+        print(render_anatomy(anatomy))
+        return 0
     print(render_timeline(group))
     return 0
+
+
+def _resolve_trace_paths(args) -> list[str]:
+    import os
+
+    return args.trace_file or (
+        [os.environ["DYN_TRACE_FILE"]]
+        if os.environ.get("DYN_TRACE_FILE")
+        else []
+    )
+
+
+def run_slow_offline(args) -> int:
+    """`llmctl slow` over a recorder span file: decompose every trace
+    and list the worst offenders by the chosen latency axis."""
+    from .telemetry import (
+        anatomy_from_spans,
+        load_spans,
+        render_anatomy,
+        render_slow,
+    )
+
+    paths = _resolve_trace_paths(args)
+    if not paths:
+        print(
+            "no trace files: pass --trace-file / set DYN_TRACE_FILE, or "
+            "pass --coordinator to scrape the live fleet",
+            file=sys.stderr,
+        )
+        return 2
+    spans = load_spans(paths)
+    by_trace: dict[str, list] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+    anatomies = [
+        a
+        for tid in sorted(by_trace)
+        if (a := anatomy_from_spans(by_trace[tid])) is not None
+    ]
+    if not anatomies:
+        print("no spans recorded", file=sys.stderr)
+        return 1
+    print(render_slow(anatomies, n=args.count, by=args.by))
+    if args.why:
+        keys = {"edge": lambda a: a.edge_latency_s,
+                "ttft": lambda a: a.ttft_s or 0.0,
+                "itl": lambda a: a.itl_s or 0.0}
+        worst = sorted(anatomies, key=lambda a: -keys[args.by](a))
+        for a in worst[: args.count]:
+            print()
+            print(render_anatomy(a))
+    return 0
+
+
+async def run_slow_live(drt, args) -> int:
+    """`llmctl slow` against a live fleet: collect every instance's
+    bounded worst-N exemplar ring (``metrics()["anatomy_slow"]``)."""
+    import asyncio
+
+    from .telemetry import RequestAnatomy, render_anatomy, render_slow
+
+    try:
+        instances = await drt.discovery.list_instances("")
+    except Exception as e:  # noqa: BLE001 - no discovery = nothing to list
+        print(f"discovery unavailable: {e}", file=sys.stderr)
+        return 1
+
+    async def one(info) -> object:
+        try:
+            return await asyncio.wait_for(
+                drt.request_plane.scrape_stats(info), 5.0
+            )
+        except Exception as e:  # noqa: BLE001 - dead member, skipped
+            return e
+
+    results = await asyncio.gather(*[one(i) for i in instances])
+    anatomies: list[RequestAnatomy] = []
+    for info, m in zip(instances, results):
+        if not isinstance(m, dict):
+            continue
+        for entry in m.get("anatomy_slow") or []:
+            if isinstance(entry, dict):
+                a = RequestAnatomy.from_dict(entry)
+                if not a.instances:
+                    a.instances = (str(info.instance_id),)
+                anatomies.append(a)
+    if not anatomies:
+        print("no request anatomy exemplars in the fleet yet")
+        return 0
+    print(render_slow(anatomies, n=args.count, by=args.by))
+    if args.why:
+        for a in sorted(anatomies, key=lambda x: -x.edge_latency_s)[: args.count]:
+            print()
+            print(render_anatomy(a))
+    return 0
+
+
+def run_fingerprint(args) -> int:
+    """`llmctl fingerprint`: characterize a recorded workload. Sniffs
+    the input format unless --kind pins it, prints the digest +
+    distribution summary, and optionally pins/diffs/replays it."""
+    from .telemetry import (
+        drift_score,
+        fingerprint_from_bench,
+        fingerprint_from_spans,
+        fingerprint_from_trace,
+        load_fingerprint,
+        load_spans,
+        render_fingerprint,
+    )
+
+    kind = args.kind
+    if kind == "auto":
+        kind = _sniff_fingerprint_kind(args.path)
+        if kind is None:
+            print(
+                f"cannot tell what {args.path!r} is — pass --kind",
+                file=sys.stderr,
+            )
+            return 2
+    try:
+        if kind == "spans":
+            fp = fingerprint_from_spans(load_spans([args.path]))
+        elif kind == "trace":
+            fp = fingerprint_from_trace(args.path)
+        elif kind == "bench":
+            fp = fingerprint_from_bench(args.path)
+        else:
+            fp = load_fingerprint(args.path)
+    except OSError as e:
+        print(f"cannot read {args.path!r}: {e}", file=sys.stderr)
+        return 2
+    if fp.n == 0:
+        print(f"no requests found in {args.path!r} (kind={kind})",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(fp.to_dict(), indent=2))
+    else:
+        print(render_fingerprint(fp))
+    if args.ref:
+        ref = load_fingerprint(args.ref)
+        print(f"drift vs {args.ref}: {drift_score(fp, ref):.4f}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(fp.to_dict(), f, indent=2)
+        print(f"# fingerprint -> {args.out}", file=sys.stderr)
+    if args.replay_out:
+        from .sim.workload import save_trace
+        from .telemetry import replay_workload
+
+        reqs = replay_workload(fp, seed=args.seed, n=args.requests)
+        n = save_trace(args.replay_out, reqs)
+        print(f"# {n} replayed requests -> {args.replay_out}",
+              file=sys.stderr)
+    return 0
+
+
+def _sniff_fingerprint_kind(path: str) -> str | None:
+    """Guess a fingerprint input's format from its first record."""
+    head = ""
+    try:
+        with open(path) as f:
+            head = f.read(65536).strip()
+    except OSError:
+        pass
+    if not head:
+        # A shared DYN_TRACE_FILE records to per-process/rotated
+        # siblings (path.pidN, path.N) that load_spans expands —
+        # sniff the first sibling so the operator can point at the
+        # configured path verbatim.
+        import glob as _glob
+
+        sib_re = re.compile(r"^(\.pid\d+)?(\.\d+)*$")
+        for cand in sorted(_glob.glob(path + ".*")):
+            if sib_re.fullmatch(cand[len(path):]):
+                try:
+                    with open(cand) as f:
+                        head = f.read(65536).strip()
+                except OSError:
+                    continue
+                if head:
+                    break
+    if not head:
+        return None
+    first = head.splitlines()[0].strip()
+    try:
+        obj = json.loads(first)
+    except ValueError:
+        # Multi-line JSON document (a saved fingerprint or a bench
+        # wrapper written with indent).
+        try:
+            obj = json.loads(head)
+        except ValueError:
+            return None
+    if not isinstance(obj, dict):
+        return None
+    if "isl_hist" in obj:
+        return "ref"
+    # Recorder lines wrap the span event: {"ts": ..., "event": {...}}.
+    ev = obj.get("event")
+    if isinstance(ev, dict) and ev.get("type") == "span":
+        return "spans"
+    if "stage" in obj and "trace_id" in obj:
+        return "spans"
+    if "arrival_s" in obj and "prompt_len" in obj:
+        return "trace"
+    if "metric" in obj or "tail" in obj or "parsed" in obj:
+        return "bench"
+    return None
 
 
 def run_flight(args) -> int:
@@ -450,6 +756,21 @@ def run_flight(args) -> int:
             file=sys.stderr,
         )
         return 1
+    if getattr(args, "why", False):
+        from .telemetry import anatomy_from_flight, render_anatomy
+
+        anatomies = anatomy_from_flight(block, args.req or None)
+        if not anatomies:
+            print(
+                "no complete request (admit..finish) in this dump block",
+                file=sys.stderr,
+            )
+            return 1
+        for i, a in enumerate(anatomies):
+            if i:
+                print()
+            print(render_anatomy(a))
+        return 0
     print(render_flight(block))
     return 0
 
@@ -685,11 +1006,29 @@ def run_bench_compare(args) -> int:
 
 async def run_top(drt, args) -> int:
     """Live fleet dashboard: scrape + render on an interval (`--once`
-    prints a single snapshot for scripts and tests)."""
+    prints a single snapshot for scripts and tests; `--json` prints the
+    rollup + per-instance views machine-readably for scripting/CI)."""
+    from dataclasses import asdict
+
     from .telemetry.fleet import FleetAggregator, render_top
 
     while True:
         view = await FleetAggregator.scrape_runtime(drt)
+        if getattr(args, "json", False):
+            print(
+                json.dumps(
+                    {
+                        "rollup": view.rollup(),
+                        "instances": {
+                            name: asdict(m)
+                            for name, m in sorted(view.members.items())
+                        },
+                        "missing": dict(view.missing),
+                    },
+                    indent=2,
+                )
+            )
+            return 0
         body = render_top(view)
         if args.once:
             print(body)
@@ -869,6 +1208,12 @@ async def run(args) -> int:
         return run_bench_compare(args)
     if args.plane == "sim":  # offline: modeled fleet, no cluster
         return run_sim(args)
+    if args.plane == "fingerprint":  # offline: reads recorded files
+        return run_fingerprint(args)
+    if args.plane == "slow" and not args.coordinator:
+        # Offline over a span file; with --coordinator it scrapes the
+        # fleet's exemplar rings below instead.
+        return run_slow_offline(args)
     if args.plane == "aot":  # offline: compile lattice, no cluster
         return await run_aot(args)
     if args.plane == "lint":  # offline: AST checks, no cluster
@@ -884,6 +1229,8 @@ async def run(args) -> int:
     try:
         if args.plane == "top":
             return await run_top(drt, args)
+        if args.plane == "slow":
+            return await run_slow_live(drt, args)
         if args.plane == "drain":
             return await drain_instance(drt, args)
         if args.plane == "disagg":

@@ -131,6 +131,10 @@ class _SimSeq:
         "gen_round", "itl", "decode_start", "first_token_at", "stalled",
         "stall_epoch", "cap_hit", "cached_tokens", "shared_hashes",
         "shared_page_count", "packing_defers", "swapped", "swap_pages",
+        # Anatomy rollup marks (SimReport.anatomy): last admission,
+        # preemption-limbo start (0 = not preempted-waiting), and when
+        # this life's decode began (0 = still prefilling).
+        "admitted_at", "preempted_at", "decode_began",
     )
 
     def __init__(self, req: SimRequest, now: float):
@@ -166,6 +170,9 @@ class _SimSeq:
         self.packing_defers = 0
         self.swapped = False
         self.swap_pages = 0
+        self.admitted_at = 0.0
+        self.preempted_at = 0.0
+        self.decode_began = 0.0
 
 
 class _SimInstance:
@@ -268,6 +275,16 @@ class ClusterSim:
         )
         self._chip_seconds = 0.0
         self._chips_since = 0.0
+        # Request-anatomy rollup (telemetry/anatomy.py component names;
+        # SimReport.anatomy): sim-clock component totals across all
+        # requests, accumulated at admission / prefill-done / preempt /
+        # finish — the sim-side mirror of the engine's anatomy_totals
+        # so fingerprint-replay calibration can compare shapes.
+        self._anatomy = dict.fromkeys(
+            ("queue_wait", "prefill_compute", "decode_compute",
+             "preemption"),
+            0.0,
+        )
         # Prefix sharing: lazily built synthetic block-hash chain per
         # prefix group (chain_hash keeps it deterministic per group id,
         # independent of arrival order), plus resident-shared-page
@@ -594,6 +611,14 @@ class ClusterSim:
                     # the sharing A/B isolates page residency, not a
                     # routing-policy change.
                     self._note_prefix_resident(inst, seq)
+            # Anatomy: close the queue-wait (first admission) or
+            # preemption-limbo (re-admission) segment.
+            if seq.preempted_at:
+                self._anatomy["preemption"] += self.loop.now - seq.preempted_at
+                seq.preempted_at = 0.0
+            else:
+                self._anatomy["queue_wait"] += self.loop.now - seq.submitted_at
+            seq.admitted_at = self.loop.now
             seq.state = SeqState.PREFILL
             inst.bound.append(seq)
             prefill_tokens = seq.prompt_len
@@ -617,6 +642,9 @@ class ClusterSim:
         cfg = self.cfg
         inst = seq.instance
         seq.state = SeqState.ACTIVE
+        # Anatomy: the prefill segment just closed; decode begins.
+        self._anatomy["prefill_compute"] += self.loop.now - seq.admitted_at
+        seq.decode_began = self.loop.now
         if not seq.first_token_at:
             seq.first_token_at = self.loop.now
             ttft = self.loop.now - seq.req.arrival_s
@@ -837,6 +865,11 @@ class ClusterSim:
         if victim.stalled:
             victim.stalled = False
             inst.stall_queue.remove(victim)
+        # Anatomy: close this life's decode segment; limbo starts now.
+        if victim.decode_began:
+            self._anatomy["decode_compute"] += self.loop.now - victim.decode_began
+            victim.decode_began = 0.0
+        victim.preempted_at = self.loop.now
         victim.state = SeqState.WAITING
         inst.waiting.append(victim)  # back of the queue, like the engine
         inst.preemptions += 1
@@ -889,6 +922,13 @@ class ClusterSim:
         inst = seq.instance
         seq.epoch += 1
         seq.state = SeqState.FINISHED
+        # Anatomy: close whichever segment this request died inside of.
+        if seq.preempted_at:
+            self._anatomy["preemption"] += self.loop.now - seq.preempted_at
+            seq.preempted_at = 0.0
+        elif seq.decode_began:
+            self._anatomy["decode_compute"] += self.loop.now - seq.decode_began
+            seq.decode_began = 0.0
         if inst is not None:
             inst.pages_free += seq.pages - seq.shared_page_count
             self._release_shared(inst, seq)
@@ -1043,6 +1083,9 @@ class ClusterSim:
         # the live edge's dynamo_goodput_requests_total /
         # dynamo_slo_violations_total equivalents).
         r.goodput_requests = self.slo_attr.goodput_total
+        # Latency anatomy rollup (same component names as the live
+        # telemetry/anatomy.py plane, restricted to what the sim models).
+        r.anatomy = {k: round(v, 6) for k, v in self._anatomy.items()}
         r.slo_violations_ttft = self.slo_attr.violations["ttft"]
         r.slo_violations_itl = self.slo_attr.violations["itl"]
         r.ttft_p50_s = percentile(self._ttfts, 0.5)

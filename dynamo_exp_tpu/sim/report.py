@@ -72,6 +72,11 @@ class SimReport:
     chip_seconds: float = 0.0
     events: int = 0
     wall_clock_s: float = 0.0
+    # Latency anatomy rollup (telemetry/anatomy.py component names,
+    # restricted to what the event model resolves): total seconds the
+    # fleet's requests spent in queue_wait / prefill_compute /
+    # decode_compute / preemption limbo, for live<->sim anatomy diffs.
+    anatomy: dict = field(default_factory=dict)
     planner_actions: list[dict] = field(default_factory=list)
     # Fleet rollup at drain time, built through the SAME
     # telemetry.fleet.FleetView path the live FleetAggregator uses

@@ -164,6 +164,18 @@ def compare_bench(
         lat_old, lat_new = _latency_fields(old), _latency_fields(new)
         for fld in sorted(set(lat_old) & set(lat_new)):
             judge(fld, lat_old[fld], lat_new[fld], higher_is_worse=True)
+        # Per-request anatomy components (bench.py _anatomy_stats, mean
+        # seconds per finished request): attribute a latency regression
+        # to the component that moved. Seconds spent — higher is worse.
+        an_old = old.get("anatomy") or {}
+        an_new = new.get("anatomy") or {}
+        for fld in sorted(set(an_old) & set(an_new)):
+            a_v, b_v = an_old[fld], an_new[fld]
+            if isinstance(a_v, (int, float)) and isinstance(
+                b_v, (int, float)
+            ):
+                judge(f"anatomy.{fld}", float(a_v), float(b_v),
+                      higher_is_worse=True)
     return report
 
 

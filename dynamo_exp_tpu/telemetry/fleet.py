@@ -56,6 +56,10 @@ _FIELD_ALIASES = {
     # tiering"): fleet views show host-tier pressure per instance.
     "host_pages": ("kv_host_pages", "host_cache_resident",
                    "dynamo_kv_host_pages"),
+    # Workload drift (docs/observability.md "Workload fingerprint"):
+    # live-vs-pinned fingerprint distance per instance.
+    "workload_drift": ("workload_drift_score",
+                       "dynamo_workload_drift_score"),
 }
 
 
@@ -269,6 +273,7 @@ class InstanceView:
     shed: int = 0
     ledger_violations: int = 0
     host_pages: int = 0
+    workload_drift: float = 0.0
     draining: bool = False
     build_info: dict = field(default_factory=dict)
     links: list[dict] = field(default_factory=list)
@@ -290,6 +295,9 @@ class InstanceView:
             _pick(m, _FIELD_ALIASES["ledger_violations"])
         )
         view.host_pages = int(_pick(m, _FIELD_ALIASES["host_pages"]))
+        view.workload_drift = round(
+            float(_pick(m, _FIELD_ALIASES["workload_drift"])), 4
+        )
         view.draining = bool(m.get("draining", False))
         bi = m.get("build_info")
         if isinstance(bi, dict):
@@ -404,6 +412,11 @@ class FleetView:
             "shed": sum(m.shed for m in members),
             "ledger_violations": sum(m.ledger_violations for m in members),
             "host_pages": sum(m.host_pages for m in members),
+            # Max (not mean): one drifted instance is the actionable
+            # signal — averaging would dilute it across a large fleet.
+            "workload_drift": round(
+                max((m.workload_drift for m in members), default=0.0), 4
+            ),
             "config_skew": self.config_skew(),
             "links": self.merged_links(),
         }
@@ -506,7 +519,8 @@ def render_top(view: FleetView) -> str:
         f"occupancy {roll['occupancy_mean']:.0%}, host pages "
         f"{roll['host_pages']}, shed {roll['shed']}, "
         f"preempt {roll['preemptions']}, ledger violations "
-        f"{roll['ledger_violations']}"
+        f"{roll['ledger_violations']}, workload drift "
+        f"{roll['workload_drift']:.2f}"
     ]
     if view.members:
         name_w = max(len(n) for n in view.members)
@@ -523,6 +537,8 @@ def render_top(view: FleetView) -> str:
                 flags.append(f"LEDGER!{m.ledger_violations}")
             if name in roll["config_skew"]:
                 flags.append("SKEW")
+            if m.workload_drift >= 0.25:
+                flags.append(f"DRIFT:{m.workload_drift:.2f}")
             lines.append(
                 f"{name:<{name_w}}  {m.running:3d} {m.waiting:4d}  "
                 f"{m.occupancy:4.0%}  {m.active_slots}/{m.total_slots}"

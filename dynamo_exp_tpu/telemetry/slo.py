@@ -20,6 +20,14 @@ question is asked:
 ``percentile`` lives here (nearest-rank, p99-of-2-samples-is-the-max)
 and is re-exported by ``sim/report.py`` — one percentile definition for
 the report, the pressure inputs, and the dispatch-profiler summaries.
+
+PR 16 grows two drift-watch surfaces on top (docs/observability.md
+"SLO burn rate & workload drift"): **multi-window burn rate** — each
+SLO axis keeps a fast (last 64 requests) and slow (last 1024) breach
+window, exported as ``dynamo_slo_burn_rate{slo,window}``, the SRE-style
+"fast window pages, slow window confirms" pair — and the module hosts
+the glue between :mod:`telemetry.fingerprint` and the engine's
+``dynamo_workload_drift_score`` gauge.
 """
 
 from __future__ import annotations
@@ -31,6 +39,12 @@ from dataclasses import dataclass
 
 # Admission priority classes (http/admission.py) -> counter label names.
 PRIORITY_NAMES = {0: "low", 1: "normal", 2: "high"}
+
+# Burn-rate window sizes, in completed requests. Request-count windows
+# (not wall-clock) keep the math deterministic and meaningful at any
+# throughput: 64 requests of signal at 1 rps or 1000 rps is the same
+# statistical confidence.
+BURN_WINDOWS = (("fast", 64), ("slow", 1024))
 
 
 def percentile(samples: list[float], q: float) -> float | None:
@@ -100,6 +114,15 @@ class SloAttribution:
         # basis when nobody resets it.
         self._win_ttft: deque[float] = deque(maxlen=window)
         self._win_itl: deque[float] = deque(maxlen=window)
+        # Burn-rate windows: per (slo axis, window name), a bounded
+        # deque of 0/1 breach outcomes for requests where that axis was
+        # measurable. Fed under the same lock as the attribution
+        # counters (one more guarded field in the zones.py manifest).
+        self._burn: dict[tuple[str, str], deque[int]] = {
+            (slo, wname): deque(maxlen=size)
+            for slo in ("ttft", "itl")
+            for wname, size in BURN_WINDOWS
+        }
 
     # ------------------------------------------------------ pressure window
     def observe_ttft(self, ttft_s: float) -> None:
@@ -158,6 +181,7 @@ class SloAttribution:
         ):
             violated.append("itl")
         name = self.priority_name(priority)
+        rates: list[tuple[str, str, float]] = []
         with self._lock:
             self.completed += 1
             for v in violated:
@@ -166,11 +190,24 @@ class SloAttribution:
                 self.goodput_by_priority[name] = (
                     self.goodput_by_priority.get(name, 0) + 1
                 )
+            # Feed every axis that was *measurable* on this request —
+            # a met target is a 0, so the window denominator is real
+            # traffic, not just breaches.
+            for slo, measured in (("ttft", ttft_s), ("itl", itl_s)):
+                target = getattr(self.cfg, f"{slo}_s")
+                if target is None or measured is None:
+                    continue
+                for wname, _size in BURN_WINDOWS:
+                    win = self._burn[(slo, wname)]
+                    win.append(1 if slo in violated else 0)
+                    rates.append((slo, wname, sum(win) / len(win)))
         if self._tel is not None:
             for v in violated:
                 self._tel.slo_violations.labels(v, name).inc()
             if not violated:
                 self._tel.goodput_requests.labels(name).inc()
+            for slo, wname, rate in rates:
+                self._tel.slo_burn_rate.labels(slo, wname).set(rate)
         return tuple(violated)
 
     def record(
@@ -192,3 +229,15 @@ class SloAttribution:
     def goodput_total(self) -> int:
         with self._lock:
             return sum(self.goodput_by_priority.values())
+
+    def burn_rates(self) -> dict[str, float]:
+        """Current breach fraction per ``"<slo>/<window>"`` key, e.g.
+        ``{"ttft/fast": 0.05, "ttft/slow": 0.01, ...}``. Only windows
+        that have received at least one measurable request appear —
+        the ``metrics()["slo_burn_rate"]`` mirror shape."""
+        with self._lock:
+            return {
+                f"{slo}/{wname}": round(sum(win) / len(win), 4)
+                for (slo, wname), win in self._burn.items()
+                if win
+            }

@@ -415,6 +415,34 @@ class Telemetry:
             ["priority"],
             registry=self.registry,
         )
+        # Request anatomy + workload fingerprint plane
+        # (docs/observability.md "Request anatomy"): per-component
+        # latency totals from the engine's per-request decomposition,
+        # the multi-window SLO burn rate, and the live-vs-pinned
+        # workload drift score.
+        self.request_seconds = Counter(
+            "dynamo_request_seconds",
+            "Request wall time decomposed by anatomy component "
+            "(telemetry/anatomy.py COMPONENTS) — summed across "
+            "finished requests",
+            ["component"],
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "dynamo_slo_burn_rate",
+            "Fraction of recent requests breaching each SLO axis, per "
+            "burn window (fast=last 64, slow=last 1024 completed "
+            "requests)",
+            ["slo", "window"],
+            registry=self.registry,
+        )
+        self.workload_drift_score = Gauge(
+            "dynamo_workload_drift_score",
+            "Normalized [0,1] distance between the live workload "
+            "fingerprint and the pinned reference (DYN_WORKLOAD_REF); "
+            "0 when no reference is pinned",
+            registry=self.registry,
+        )
         # Fleet observability plane (docs/observability.md "Fleet
         # plane"): the KV conservation auditor's violation counter (0 in
         # any healthy run — a nonzero value names a page-accounting bug,

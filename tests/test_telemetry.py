@@ -628,3 +628,42 @@ def test_fleet_plane_surface_is_documented():
     with open(os.path.join(here, "..", "README.md")) as f:
         readme = f.read()
     assert "Fleet plane" in readme
+
+
+def test_anatomy_plane_surface_is_documented():
+    """Doc-sync guard (anatomy-plane extension): the request-anatomy
+    component vocabulary, the fingerprint/drift surface, and the new
+    operator commands must land in docs/observability.md, with the
+    suite row in docs/testing.md and the README pointer — same
+    discipline as the fleet-plane guard above."""
+    import os
+
+    from dynamo_exp_tpu.telemetry.anatomy import COMPONENTS
+
+    doc = _observability_doc()
+    assert "## Request anatomy" in doc
+    assert "## Workload fingerprint" in doc
+    # Every anatomy component name is contract surface: prometheus
+    # label, metrics() mirror key, bench-line field, --why waterfall.
+    missing = [c for c in COMPONENTS if c not in doc]
+    assert not missing, (
+        f"anatomy components undocumented in docs/observability.md: "
+        f"{missing}"
+    )
+    for cmd in (
+        "llmctl slow",
+        "llmctl fingerprint",
+        "llmctl trace 4f1f2a --trace-file /tmp/trace.jsonl --why",
+        "DYN_WORKLOAD_REF",
+        "dynamo_slo_burn_rate",
+    ):
+        assert cmd in doc, f"{cmd!r} undocumented in docs/observability.md"
+
+    here = os.path.dirname(__file__)
+    with open(os.path.join(here, "..", "docs", "testing.md")) as f:
+        testing = f.read()
+    assert "test_anatomy.py" in testing
+    with open(os.path.join(here, "..", "README.md")) as f:
+        readme = f.read()
+    assert "Request anatomy" in readme
+    assert "llmctl fingerprint" in readme
