@@ -26,6 +26,11 @@ SPEC_SEED_SETS := 7,21,1337
 # identity sweep (proactive offload + prefetch under pressure,
 # conservation-audited) in tests/test_kv_tiering.py.
 TIERING_SEED_SETS := 7,21,1337 3,9,27
+# Spot-reclamation seed sets: deadline-bounded live migration +
+# journal failover (tests/test_reclaim.py) — migrated streams must be
+# token-identical to uninterrupted oracles, and a too-short grace must
+# degrade to journal failover with zero lost/duplicated tokens.
+RECLAIM_SEED_SETS := 7,21,1337 5,8,13
 
 .PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare anatomy-smoke
 
@@ -66,6 +71,14 @@ chaos:
 	for seeds in $(TIERING_SEED_SETS); do \
 		echo "=== predictive KV tiering sweep, CHAOS_SEEDS=$$seeds ==="; \
 		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_kv_tiering.py -q -m chaos; \
+	done; \
+	for seeds in $(RECLAIM_SEED_SETS); do \
+		echo "=== spot-reclamation suite, CHAOS_SEEDS=$$seeds ==="; \
+		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_reclaim.py -q -m chaos; \
+	done; \
+	for seeds in $(SPEC_SEED_SETS); do \
+		echo "=== spec-on reclaim identity (DYN_SPEC=ngram), CHAOS_SEEDS=$$seeds ==="; \
+		env DYN_SPEC=ngram CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_reclaim.py -q -m chaos; \
 	done
 
 # Seeded simulator regression sets (mirrors `make chaos`): every seed

@@ -72,6 +72,11 @@ CPU_SPEC_KW = dict(slots=2, isl=96, osl=32, draft_lens=(0, 4))
 # Coldstart sweep CPU fallback: small shapes, the same trim policy.
 CPU_COLDSTART_KW = dict(isl=64, osl=16, concurrency=2)
 
+# Reclaim sweep CPU trim: the sweep is sim-driven (no chip, no
+# compile), so the trim only shortens the simulated window and drops a
+# rate point to keep the CI lane seconds-scale.
+CPU_RECLAIM_KW = dict(duration_s=120.0, reclaim_rates=(0.0, 2.0, 6.0))
+
 # Burst policy: warmup rounds (compile + program load) and timed rounds
 # (best-of). The CPU fallback trims both to 1 — XLA:CPU timings are
 # low-variance and a 1B-model burst is minutes, not seconds, there.
@@ -1253,6 +1258,104 @@ def run_coldstart_sweep(
     return [cold, warm, summary]
 
 
+def run_reclaim_sweep(
+    seed: int = 11,
+    spot_fraction: float = 0.5,
+    grace_s: float = 4.0,
+    duration_s: float = 240.0,
+    instances: int = 4,
+    reclaim_rates: tuple[float, ...] = (0.0, 2.0, 6.0, 12.0),
+) -> list[dict]:
+    """Spot-reclamation economics: goodput, migrated-vs-failover split,
+    p99 TTFT, and billed chip-seconds per reclaim rate
+    (docs/fault_tolerance.md "Spot reclamation & live migration").
+
+    Sim-driven (no chip): a fixed fleet with ``spot_fraction`` of its
+    instances on spot capacity serves one deterministic ramp while
+    reclaim notices arrive at each swept rate, each with ``grace_s`` of
+    warning. Every notice runs the REAL ``runtime.reclaim.plan_triage``
+    deadline planner, so the migrated fraction per line is the live
+    triage policy's hit rate at that grace window, not a modeling knob.
+
+    The first line is the all-on-demand control (``spot_fraction=0``,
+    no reclaims, full price); spot lines report ``vs_baseline`` as
+    goodput relative to it. The headline is the pair (``vs_baseline``,
+    ``goodput_per_billed_chip_s``): a healthy triage plane holds
+    goodput near the control while billed chip-seconds shrink by the
+    spot discount — and rising ``reclaim_failovers`` with falling
+    ``migrated_fraction`` at high rates shows exactly where the grace
+    deadline stops covering the transfer bill."""
+    from dynamo_exp_tpu.sim.cluster import ClusterSim, SimConfig
+    from dynamo_exp_tpu.sim.workload import ramp_workload
+
+    def one(rate: float, spot: float, label: str) -> dict:
+        cfg = SimConfig(
+            seed=seed,
+            slots_per_instance=8,
+            pages_per_instance=144,
+            page_size=16,
+            max_inflight=16,
+            shed_watermark=12,
+            admission_per_instance=True,
+            initial_instances=instances,
+            provision_s=5.0,
+            spot_fraction=spot,
+            reclaim_rate_per_min=rate,
+            reclaim_grace_s=grace_s,
+            record_events=False,
+        )
+        wl = ramp_workload(
+            seed,
+            duration_s=duration_s,
+            rps_start=2.0,
+            rps_end=8.0,
+            prompt_len=(64, 256),
+            max_tokens=(16, 64),
+        )
+        rep = ClusterSim(cfg, wl).run()
+        moved = rep.reclaim_migrated + rep.reclaim_failovers
+        return {
+            "metric": f"reclaim_sweep_spot{int(spot * 100)}"
+            f"_g{grace_s:g}_{label}",
+            "value": rep.goodput_tok_s,
+            "unit": "goodput tok/s",
+            "reclaim_rate_per_min": rate,
+            "spot_fraction": spot,
+            "grace_s": grace_s,
+            "reclaims": rep.reclaims,
+            "reclaim_migrated": rep.reclaim_migrated,
+            "reclaim_failovers": rep.reclaim_failovers,
+            "reclaim_migrated_pages": rep.reclaim_migrated_pages,
+            "migrated_fraction": round(rep.reclaim_migrated / moved, 4)
+            if moved
+            else None,
+            "ttft_p99_s": rep.ttft_p99_s,
+            "submitted": rep.submitted,
+            "completed": rep.completed,
+            "preemptions": rep.preemptions,
+            "chip_seconds": rep.chip_seconds,
+            "billed_chip_seconds": rep.billed_chip_seconds,
+            "goodput_per_billed_chip_s": round(
+                rep.completed_tokens / rep.billed_chip_seconds, 2
+            )
+            if rep.billed_chip_seconds
+            else None,
+        }
+
+    base = one(0.0, 0.0, "ondemand")
+    base["vs_baseline"] = 1.0
+    out = [base]
+    for rate in reclaim_rates:
+        point = one(rate, spot_fraction, f"r{rate:g}")
+        point["vs_baseline"] = (
+            round(point["value"] / base["value"], 4)
+            if base["value"]
+            else None
+        )
+        out.append(point)
+    return out
+
+
 def _fall_back_to_cpu(reason: str) -> str:
     """Pin this process (and its children) to the XLA CPU backend.
     Env var for anything imported later, config update in case a
@@ -1363,6 +1466,13 @@ def main() -> None:
         "against one persistent compile cache (docs/aot.md)",
     )
     ap.add_argument(
+        "--reclaim-sweep",
+        action="store_true",
+        help="spot-reclamation economics (sim-driven): goodput, "
+        "migrated-vs-failover split, p99 TTFT, and billed "
+        "chip-seconds per reclaim rate vs an all-on-demand control",
+    )
+    ap.add_argument(
         "--prewarm",
         action="store_true",
         help="prewarm every bench engine from the compile lattice "
@@ -1408,6 +1518,17 @@ def main() -> None:
         )
 
     cpu = platform == "cpu"
+    if args.reclaim_sweep:
+        # Sim-driven: numbers are host-independent, so lines carry
+        # platform="sim" — chip and CPU-fallback captures of this
+        # sweep stay comparable in `llmctl bench compare` instead of
+        # being skipped as a platform mismatch.
+        for point in run_reclaim_sweep(**(CPU_RECLAIM_KW if cpu else {})):
+            print(
+                json.dumps(dict(LINE_TAGS) | point | {"platform": "sim"}),
+                flush=True,
+            )
+        return
     if args.coldstart_sweep:
         for point in run_coldstart_sweep(**(CPU_COLDSTART_KW if cpu else {})):
             emit(point)

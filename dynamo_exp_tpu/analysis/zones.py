@@ -74,6 +74,22 @@ DETERMINISM_ZONES: tuple[Zone, ...] = (
     # id() / dict-order effects.
     Zone("dynamo_exp_tpu/telemetry/anatomy.py"),
     Zone("dynamo_exp_tpu/telemetry/fingerprint.py"),
+    # The spot-reclamation triage planner (docs/fault_tolerance.md
+    # "Spot reclamation & live migration") is shared verbatim between
+    # the live ReclaimController and the simulator's reclaim event —
+    # same snapshot + survivors + grace must always produce the same
+    # plan, so the pure planning functions sit in zone. The controller
+    # itself is wall-clock-driven by design (it races a SIGKILL
+    # deadline) and stays out.
+    Zone(
+        "dynamo_exp_tpu/runtime/reclaim.py",
+        include=(
+            "plan_triage",
+            "nearest_survivor",
+            "migration_lease_ttl_s",
+            "survivors_from_instances",
+        ),
+    ),
 )
 
 # ------------------------------------------------- thread-ownership model
@@ -97,6 +113,14 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
             "metrics",  # /metrics scrapes from serving threads
             "_flight_snapshot",  # watchdog thread
             "_dump_flight",  # watchdog / SIGUSR1 / crash paths
+            # Spot-reclamation plane (docs/fault_tolerance.md): asyncio
+            # ingress for the triage snapshot / page extraction /
+            # survivor-side prefix seeding — all serviced on the loop
+            # through _reclaim_q.
+            "reclaim_inflight",
+            "reclaim_extract",
+            "seed_prefix",
+            "_reclaim_call",
         ),
         loop_owned=frozenset(
             {
@@ -161,6 +185,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_submit_q",
                 "_lease_confirm_q",
                 "_pin_q",
+                "_reclaim_q",  # reclaim plane ingress -> loop
                 "_prefetch_done_q",  # copy thread -> loop (fetch results)
                 "_wake",
                 # Lifecycle flags/threads, written only before the loop

@@ -95,6 +95,7 @@ async def send_kv_pages(
     window: int = DEFAULT_WINDOW,
     lease: "object | None" = None,  # disagg.protocol.LeaseGrant
     dst_instance: str = "",
+    extra_header: dict | None = None,
 ) -> None:
     """Deliver one prefill result (or failure notice) to a decode worker.
 
@@ -165,6 +166,10 @@ async def send_kv_pages(
             begin["trace"] = trace
         if lease is not None:
             begin.update(lease.to_header())
+        if extra_header:
+            # Caller-supplied BEGIN metadata (the reclaim plane ships
+            # its block-hash chain here — docs/fault_tolerance.md).
+            begin.update(extra_header)
         await write_message(writer, TwoPartMessage(MsgType.FRAME, begin))
         unacked = 0
         for idx, chunk in enumerate(chunks):
@@ -229,6 +234,12 @@ class KvPageReceiver:
         self._server: asyncio.AbstractServer | None = None
         self._pending: dict[str, asyncio.Future] = {}
         self._chunk_cbs: dict[str, object] = {}
+        # Late-claim hook for transfers nobody pre-registered: called
+        # with (request_id, begin_header) and may call expect() to adopt
+        # the transfer before it is dropped. The reclaim plane's
+        # MigrationSink claims "migrate:*" ids here — a dying sender
+        # cannot pre-announce through any channel but the wire itself.
+        self.on_unclaimed = None
 
     @property
     def address(self) -> str:
@@ -275,6 +286,14 @@ class KvPageReceiver:
             msg = await read_message(reader)
             rid = msg.header.get("request_id", "")
             fut = self._pending.pop(rid, None)
+            if (
+                fut is None
+                and self.on_unclaimed is not None
+                and msg.header.get("kind") == "begin"
+            ):
+                with contextlib.suppress(Exception):
+                    self.on_unclaimed(rid, dict(msg.header))
+                fut = self._pending.pop(rid, None)
             if fut is None or fut.done():
                 logger.warning("KV pages for unknown request %s dropped", rid)
                 # Still drain the sender's frames so it doesn't hang on

@@ -419,6 +419,11 @@ class TPUEngine(AsyncEngine):
         # pins the answer under a lease. Served on the loop thread (the
         # manager's single writer); results travel back via futures.
         self._pin_q: queue.Queue[tuple] = queue.Queue()
+        # Spot-reclamation plane (docs/fault_tolerance.md "Spot
+        # reclamation & live migration"): triage snapshots, live-KV
+        # extracts and survivor-side prefix seeding all mutate the page
+        # manager, so they queue for the loop thread exactly like pins.
+        self._reclaim_q: queue.Queue[tuple] = queue.Queue()
         # Telemetry counter snapshot (prefix sharing): the prometheus
         # prefix-hit mirror advances by delta at gauge-publish time (the
         # page manager itself is telemetry-free; COW has its own event-
@@ -1187,6 +1192,238 @@ class TPUEngine(AsyncEngine):
                     self.kv.confirm_lease(lease)
                     self._close_lease_span(lease, "confirmed")
 
+    # ------------------------------------------------- spot-reclamation plane
+    def kv_page_nbytes(self) -> int:
+        """Host bytes one KV page occupies on the migration wire (both
+        K and V, all layers) — the triage planner's cost unit."""
+        m = self.cfg.model
+        itemsize = 2 if self.cfg.kv_dtype == "bfloat16" else 4
+        return (
+            2
+            * m.num_layers
+            * self.cfg.page_size
+            * m.num_kv_heads
+            * m.head_dim_
+            * itemsize
+        )
+
+    async def reclaim_inflight(self) -> list[dict]:
+        """Triage snapshot for the reclaim plane (docs/fault_tolerance.md
+        "Spot reclamation & live migration"): every migratable in-flight
+        sequence with its priority and shippable KV size. Thread-safe
+        (serviced on the engine loop, the scheduler's single writer).
+        Swapped-out rows and disagg extract legs are excluded — their
+        KV is not cleanly device-resident, so they ride the journal."""
+        return await self._reclaim_call("snapshot", None, default=[])
+
+    async def reclaim_extract(
+        self, request_id: str, ttl_s: float
+    ) -> tuple[list[int], list, str] | None:
+        """Live-migration extract: host-bounce the sequence's *complete*
+        KV pages (one batched gather), pin them under a ``ttl_s`` lease
+        (clamp it past the reclaim grace — see
+        :func:`~dynamo_exp_tpu.runtime.reclaim.migration_lease_ttl_s`),
+        and return ``(block_hashes, kv_pages, lease_id)``. The partial
+        tail page is never shipped — the journal continuation re-prefills
+        it on the survivor, which keeps migration a pure prefix-cache
+        transplant. Returns None when the sequence finished or is not in
+        a migratable state (the caller degrades to journal failover)."""
+        return await self._reclaim_call(
+            "extract", (request_id, ttl_s), default=None
+        )
+
+    async def seed_prefix(self, hashes: list[int], pages: list) -> int:
+        """Survivor side of live KV migration: inject the shipped blocks
+        (one batched scatter) and register them as parked, matchable
+        prefix pages — refcount 0, reclaimable-LRU, identical to a
+        finished sequence's pages. The migrated request's journal
+        continuation then admission-matches them instead of
+        re-prefilling. Returns blocks actually seeded (pool pressure may
+        park a shorter — still contiguous — prefix)."""
+        return await self._reclaim_call("seed", (hashes, pages), default=0)
+
+    async def _reclaim_call(self, op: str, payload, default):
+        if not self._running:
+            return default
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._reclaim_q.put((op, payload, loop, fut))
+        self._wake.set()
+        if not self._running and not fut.done():
+            # stop() drained the queue before our put landed (same race
+            # as pin_prefix): resolve it ourselves.
+            fut.set_result(default)
+        return await fut
+
+    def _service_reclaims(self) -> None:
+        """Engine-loop side of the reclaim plane entry points."""
+        while True:
+            try:
+                op, payload, loop, fut = self._reclaim_q.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                if op == "snapshot":
+                    result = self._reclaim_snapshot()
+                elif op == "extract":
+                    result = self._reclaim_extract(*payload)
+                else:
+                    result = self._seed_prefix(*payload)
+            except Exception as err:
+                log.exception("reclaim op %s failed", op)
+
+                def fail(f=fut, e=err):
+                    f.done() or f.set_exception(e)
+
+                try:
+                    loop.call_soon_threadsafe(fail)
+                except RuntimeError:
+                    pass
+                continue
+
+            def resolve(f=fut, r=result, op=op):
+                # Runs on the caller's event loop. An extract whose
+                # caller gave up (deadline hit between queue and
+                # service) must hand its lease back, not wait out TTL.
+                if f.done():
+                    if op == "extract" and r is not None:
+                        self.confirm_kv_lease(r[2])
+                else:
+                    f.set_result(r)
+
+            try:
+                loop.call_soon_threadsafe(resolve)
+            except RuntimeError:
+                if op == "extract" and result is not None:
+                    self.kv.confirm_lease(result[2])
+                    self._close_lease_span(result[2], "confirmed")
+
+    def _reclaim_snapshot(self) -> list[dict]:
+        ps = self.cfg.page_size
+        nb = self.kv_page_nbytes()
+        out = []
+        for seq in self.sched.slots:
+            if (
+                seq is None
+                or seq.state is not SeqState.ACTIVE
+                or seq.swap is not None
+                or seq.extract_cb is not None
+            ):
+                continue
+            # Only positions up to pos-1 have KV written (the newest
+            # sampled token's KV lands next step) — same bound as
+            # Scheduler.register_full_pages.
+            full = min(max(0, (seq.pos - 1) // ps), len(seq.page_ids))
+            out.append(
+                {
+                    "request_id": seq.request_id,
+                    "priority": seq.priority,
+                    "full_pages": full,
+                    "kv_bytes": full * nb,
+                    "tokens_generated": max(
+                        0, len(seq.tokens) - len(seq.prompt)
+                    ),
+                }
+            )
+        return out
+
+    def _reclaim_extract(
+        self, request_id: str, ttl_s: float
+    ) -> tuple[list[int], list, str] | None:
+        seq = next(
+            (
+                s
+                for s in self.sched.slots
+                if s is not None
+                and s.request_id == request_id
+                and s.state is SeqState.ACTIVE
+                and s.swap is None
+            ),
+            None,
+        )
+        if seq is None:
+            return None
+        ps = self.cfg.page_size
+        full = min(max(0, (seq.pos - 1) // ps), len(seq.page_ids))
+        if full <= 0:
+            return None
+        pids = seq.page_ids[:full]
+        # The chained block hashes ARE the migration identity: the
+        # survivor registers the pages under them, and the journal
+        # continuation (same prompt + confirmed tokens) recomputes the
+        # same chain at admission — content-addressed re-attachment, no
+        # request-id coupling.
+        hashes = compute_block_hashes_for_seq(seq.tokens, ps)[:full]
+        k_b, v_b = self._gather_page_batch(pids)
+        k_np, v_np = np.asarray(k_b), np.asarray(v_b)  # dynlint: sync-point(reclaim extract gather consume)
+        if self.profiler is not None:
+            self.profiler.consume("kv_move", self._last_move_t)
+        if self.flight is not None:
+            self.flight.record("consume", dispatch="kv_move", pages=full)
+        get_telemetry().kv_page_moves.labels("extract").inc(full)
+        lease_id = self.kv.grant_lease(pids, ttl_s)
+        if seq.trace is not None:
+            self._lease_traces[lease_id] = (seq.trace, time.time())
+        if self.flight is not None:
+            self.flight.record(
+                "lease_grant", req=seq.request_id, pages=full
+            )
+        pages = [
+            (
+                np.ascontiguousarray(k_np[:, i]),
+                np.ascontiguousarray(v_np[:, i]),
+            )
+            for i in range(full)
+        ]
+        return hashes, pages, lease_id
+
+    def _seed_prefix(self, hashes: list[int], pages: list) -> int:
+        if not self.kv.sharing:
+            return 0
+        seeded_pids: list[int] = []
+        seed_k: list = []
+        seed_v: list = []
+        parent: int | None = None
+        for i, h in enumerate(hashes[: len(pages)]):
+            if self.kv.resident_page(h) is not None:
+                parent = h  # block already here: extend the chain past it
+                continue
+            pid = self.kv.allocate_page()
+            if pid is None:
+                break  # pool dry: a shorter contiguous prefix still matches
+            k, v = pages[i]
+            self.kv.register_full_page(pid, h, parent_hash=parent)
+            seeded_pids.append(pid)
+            seed_k.append(k)
+            seed_v.append(v)
+            parent = h
+        if seeded_pids:
+            self._inject_page_batch(seeded_pids, seed_k, seed_v, op="inject")
+            self.kv.mark_filled(seeded_pids)
+            # Park (refcount 0, reclaimable LRU, matchable) — exactly a
+            # finished sequence's pages. The continuation re-references
+            # them at admission; until then LRU pressure may evict them,
+            # which costs re-prefill, never correctness.
+            self.kv.release_sequence(seeded_pids)
+        return len(seeded_pids)
+
+    def _drain_reclaim_q(self) -> None:
+        """Resolve every queued reclaim-plane request with its no-op
+        answer — shutdown must never strand an awaiting controller."""
+        defaults = {"snapshot": [], "extract": None, "seed": 0}
+        while not self._reclaim_q.empty():
+            try:
+                op, _payload, loop, fut = self._reclaim_q.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                loop.call_soon_threadsafe(
+                    lambda f=fut, r=defaults.get(op): f.done()
+                    or f.set_result(r)
+                )
+            except RuntimeError:
+                pass
+
     # -------------------------------------------------------------- the loop
     def _loop(self) -> None:
         """One iteration = admit everything admissible, then dispatch
@@ -1215,6 +1452,7 @@ class TPUEngine(AsyncEngine):
                 # writer — every iteration, busy or idle.
                 self._service_leases()
                 self._service_pins()
+                self._service_reclaims()
                 # Conservation auditor: O(1) counter arithmetic over the
                 # page ledger, every iteration, busy or idle — a leaked
                 # ref or double-release is caught within one loop pass
@@ -2232,6 +2470,7 @@ class TPUEngine(AsyncEngine):
             except queue.Empty:
                 break
         self._drain_pin_q()
+        self._drain_reclaim_q()
 
     def _drain_pin_q(self) -> None:
         """Resolve every queued prefix-pin request with the no-coverage
@@ -3575,4 +3814,9 @@ class TPUEngine(AsyncEngine):
         from ..telemetry.fleet import get_transfer_ledger
 
         m["kv_links"] = get_transfer_ledger().snapshot()
+        # Cold-start prior the reclaim triage planner uses on links with
+        # no observed transfer yet (docs/fault_tolerance.md).
+        m["kv_default_bandwidth_bps"] = (
+            get_transfer_ledger().default_bandwidth_bps
+        )
         return m

@@ -180,6 +180,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     drain.add_argument("instance_id", type=int)
 
+    # Spot-reclamation notice: like drain, but with a hard deadline the
+    # worker's ReclaimController triages under — live KV migration for
+    # what fits, journal failover for the rest
+    # (docs/fault_tolerance.md "Spot reclamation & live migration").
+    reclaim = sub.add_parser(
+        "reclaim",
+        help="send a reclaim notice (deadline-bounded drain + live KV "
+        "migration) to a worker instance",
+    )
+    reclaim.add_argument("instance_id", type=int)
+    reclaim.add_argument(
+        "--grace-s",
+        type=float,
+        default=30.0,
+        help="grace window in seconds before the instance is killed "
+        "(default 30)",
+    )
+
     # Offline trace reconstruction from the telemetry recorder JSONL
     # (``DYN_TRACE_FILE``): no argument lists recorded traces; with a
     # trace_id (full/prefix) or request id, pretty-prints its span tree.
@@ -1173,6 +1191,28 @@ async def drain_instance(drt, args) -> int:
     return 0
 
 
+async def reclaim_instance(drt, args) -> int:
+    from .runtime.component import RECLAIM_PREFIX
+
+    live = {
+        i.instance_id
+        for i in await drt.discovery.list_instances("")
+    }
+    if args.instance_id not in live:
+        print(f"instance {args.instance_id} is not live", file=sys.stderr)
+        return 1
+    payload = json.dumps({"grace_s": args.grace_s}).encode()
+    await drt.discovery.kv_put(
+        f"{RECLAIM_PREFIX}{args.instance_id}", payload
+    )
+    print(
+        f"reclaim notice sent to instance {args.instance_id} "
+        f"(grace {args.grace_s:g}s); in-flight sequences triage into "
+        "live migration or journal failover under the deadline"
+    )
+    return 0
+
+
 async def get_disagg(drt, args) -> int:
     from .disagg.config import DisaggConfig, disagg_config_key
 
@@ -1233,6 +1273,8 @@ async def run(args) -> int:
             return await run_slow_live(drt, args)
         if args.plane == "drain":
             return await drain_instance(drt, args)
+        if args.plane == "reclaim":
+            return await reclaim_instance(drt, args)
         if args.plane == "disagg":
             if args.command == "get":
                 return await get_disagg(drt, args)

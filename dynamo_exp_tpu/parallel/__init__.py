@@ -8,6 +8,7 @@ from .mesh import (
 )
 from .multihost import (
     MultiNodeConfig,
+    TopologyCoordinate,
     bringup,
     detect_host_ip,
     initialize_multihost,
@@ -22,6 +23,7 @@ __all__ = [
     "shard_pytree",
     "largest_tp",
     "MultiNodeConfig",
+    "TopologyCoordinate",
     "bringup",
     "detect_host_ip",
     "initialize_multihost",

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import socket
 from dataclasses import dataclass
 
@@ -31,6 +32,72 @@ logger = logging.getLogger(__name__)
 LEADER_KEY_PREFIX = "multihost/"
 LEADER_LEASE_TTL_S = 30.0
 DEFAULT_DIST_PORT = 9911
+
+# Discovery-metadata key a served instance publishes its coordinate
+# under ("slice/host/chip" string — see TopologyCoordinate.parse).
+TOPOLOGY_KEY = "topology"
+
+
+@dataclass(frozen=True)
+class TopologyCoordinate:
+    """Where an instance sits in the TPU fleet: (slice, host, chip).
+
+    The reclaim survivor selector and the topology-aware decode
+    selector use :meth:`distance` as a *tiebreak prior* next to the
+    TransferLedger's measured bandwidth: same-host beats same-slice
+    (ICI) beats cross-slice (DCN). The coordinate is deployment
+    metadata, not something JAX can introspect portably — deployments
+    set ``DYN_TOPOLOGY=slice/host/chip`` per process (defaults derive
+    slice 0 / host ``node_rank`` / chip 0 from :class:`MultiNodeConfig`).
+    """
+
+    slice_id: int = 0
+    host: int = 0
+    chip: int = 0
+
+    # Distance tiers, widest first: 0 = same chip, 1 = same host,
+    # 2 = same slice (ICI), 3 = cross-slice (DCN).
+    def distance(self, other: "TopologyCoordinate") -> int:
+        if self.slice_id != other.slice_id:
+            return 3
+        if self.host != other.host:
+            return 2
+        if self.chip != other.chip:
+            return 1
+        return 0
+
+    def encode(self) -> str:
+        return f"{self.slice_id}/{self.host}/{self.chip}"
+
+    @classmethod
+    def parse(cls, raw: str | None) -> "TopologyCoordinate | None":
+        """Parse a "slice/host/chip" metadata string (missing trailing
+        parts default to 0; garbage returns None — callers treat an
+        unknown coordinate as maximally distant)."""
+        if not raw:
+            return None
+        parts = str(raw).strip().split("/")
+        try:
+            nums = [int(p) for p in parts if p != ""]
+        except ValueError:
+            return None
+        if not nums:
+            return None
+        nums = (nums + [0, 0, 0])[:3]
+        return cls(slice_id=nums[0], host=nums[1], chip=nums[2])
+
+    @classmethod
+    def from_env(
+        cls, cfg: "MultiNodeConfig | None" = None
+    ) -> "TopologyCoordinate":
+        """This process's coordinate: ``DYN_TOPOLOGY`` wins; otherwise
+        derive host from the multi-node rank (single-node dev collapses
+        to 0/0/0 — every peer same-host, distance a constant, so the
+        ledger's measured bandwidth fully decides)."""
+        parsed = cls.parse(os.environ.get("DYN_TOPOLOGY", ""))
+        if parsed is not None:
+            return parsed
+        return cls(slice_id=0, host=cfg.node_rank if cfg else 0, chip=0)
 
 
 @dataclass

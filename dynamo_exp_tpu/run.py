@@ -445,7 +445,50 @@ async def run_worker(opts, drt, core, tpu_engine, mdc=None):
             yield frame
 
     metrics_pub = KvMetricsPublisher()
-    served = await ep.serve_endpoint(handler, stats_handler=metrics_pub.stats_handler)
+    # Spot-reclamation plane (docs/fault_tolerance.md "Spot reclamation
+    # & live migration"): advertise the metadata survivors select on —
+    # this worker's telemetry/ledger name, its topology coordinate, and
+    # (when the engine can park migrated prefixes) a live KV-migration
+    # landing address — then arm the triage controller below.
+    from .parallel.multihost import TOPOLOGY_KEY, TopologyCoordinate
+    from .runtime.reclaim import (
+        MigrationSink,
+        ReclaimController,
+        install_sigterm_reclaim,
+        survivors_from_instances,
+    )
+    from .telemetry import get_telemetry
+
+    topo = TopologyCoordinate.from_env()
+    metadata: dict = {"instance": get_telemetry().instance}
+    if topo is not None:
+        metadata[TOPOLOGY_KEY] = topo.encode()
+    migrate_rx = None
+    migrate_sink = None
+    if tpu_engine is not None and tpu_engine.kv.sharing:
+        from .disagg.transfer import KvPageReceiver
+
+        migrate_rx = KvPageReceiver()
+        await migrate_rx.start()
+        migrate_sink = MigrationSink(tpu_engine, migrate_rx)
+        metadata["migrate_addr"] = migrate_rx.address
+    served = await ep.serve_endpoint(
+        handler, stats_handler=metrics_pub.stats_handler, metadata=metadata
+    )
+    if tpu_engine is not None:
+
+        async def _survivors():
+            infos = await drt.discovery.list_instances(ep.component.path)
+            return survivors_from_instances(infos, served.instance_id)
+
+        ReclaimController(
+            tpu_engine, topology=topo, survivors_fn=_survivors
+        ).attach(served)
+        # SIGTERM == the spot platform's reclaim notice: triage the
+        # in-flight KV within the grace window, then fall through to
+        # the graceful drain this handler displaced (cancel the main
+        # task, exactly what run_main's own SIGTERM handler did).
+        install_sigterm_reclaim(served, then=asyncio.current_task().cancel)
 
     if tpu_engine is not None:
         # KV events -> router index, attributed to this instance.
@@ -516,6 +559,10 @@ async def run_worker(opts, drt, core, tpu_engine, mdc=None):
             await asyncio.wait_for(served.close(), 15)
         except asyncio.TimeoutError:
             logger.warning("endpoint close timed out after 15s")
+        if migrate_sink is not None:
+            migrate_sink.close()
+        if migrate_rx is not None:
+            await migrate_rx.close()
         logger.info("endpoint closed in %.2fs", time.monotonic() - t0)
 
 

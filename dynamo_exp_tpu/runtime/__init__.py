@@ -36,6 +36,15 @@ from .pipeline import (
     build_segment,
 )
 from .pool import Pool, PoolItem
+from .reclaim import (
+    MigrationSink,
+    ReclaimController,
+    SequenceSnapshot,
+    SurvivorInfo,
+    install_sigterm_reclaim,
+    migration_lease_ttl_s,
+    plan_triage,
+)
 from .push_router import (
     NoHealthyInstancesError,
     NoInstancesError,
@@ -67,6 +76,7 @@ __all__ = [
     "LambdaEngine",
     "Lease",
     "MapOperator",
+    "MigrationSink",
     "Namespace",
     "NoHealthyInstancesError",
     "NoInstancesError",
@@ -76,6 +86,7 @@ __all__ = [
     "Pool",
     "PoolItem",
     "PushRouter",
+    "ReclaimController",
     "RecoveryExhaustedError",
     "ReplayJournal",
     "ResponseStream",
@@ -84,7 +95,9 @@ __all__ = [
     "RuntimeConfig",
     "SegmentSink",
     "SegmentSource",
+    "SequenceSnapshot",
     "ServedInstance",
+    "SurvivorInfo",
     "ServiceBackend",
     "ServiceFrontend",
     "Worker",
@@ -92,5 +105,8 @@ __all__ = [
     "build_pipeline",
     "build_segment",
     "configure_logging",
+    "install_sigterm_reclaim",
     "is_draining",
+    "migration_lease_ttl_s",
+    "plan_triage",
 ]

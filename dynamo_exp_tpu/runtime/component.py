@@ -42,6 +42,18 @@ logger = logging.getLogger(__name__)
 # so the operator plane never has to forge registrations).
 DRAIN_PREFIX = "v1/drain/"
 
+# KV prefix where spot-reclamation notices land: ``llmctl reclaim
+# <instance> --grace-s N`` writes ``{RECLAIM_PREFIX}{instance_id}`` with
+# a JSON ``{"grace_s": N}`` payload. Same watch discipline as drain,
+# but the value carries the platform's grace window so the instance's
+# ReclaimController can run deadline-bounded triage under it
+# (docs/fault_tolerance.md "Spot reclamation & live migration").
+RECLAIM_PREFIX = "v1/reclaim/"
+
+# Default grace window when a reclaim notice carries none (SIGTERM,
+# malformed payload): seconds-scale, matching typical spot preemption.
+DEFAULT_RECLAIM_GRACE_S = 30.0
+
 # Endpoints served under one lease, for composing unique instance ids.
 # Per-lease (not process-global): a long-lived process serving many
 # endpoints across many leases must never overflow one lease's id range
@@ -271,6 +283,7 @@ class Endpoint:
         logger.info("serving endpoint %s as instance %d", self.path, info.instance_id)
         instance = ServedInstance(self, info, served, lease)
         instance._start_drain_watch()
+        instance._start_reclaim_watch()
         return instance
 
     async def client(
@@ -307,6 +320,13 @@ class ServedInstance:
         self._served = served
         self.lease = lease
         self._drain_task = None
+        self._reclaim_task = None
+        # Reclaim hook: ``async def on_reclaim(grace_s: float)`` —
+        # typically ReclaimController.run (runtime/reclaim.py). Invoked
+        # once, after the ``reclaiming`` metadata republish, inside the
+        # grace window. None = metadata-only reclaim (routers stop
+        # sending; in-flight streams ride the journal failover path).
+        self.on_reclaim = None
 
     @property
     def instance_id(self) -> int:
@@ -317,6 +337,12 @@ class ServedInstance:
         from .health import is_draining
 
         return is_draining(self.info)
+
+    @property
+    def is_reclaiming(self) -> bool:
+        from .health import is_reclaiming
+
+        return is_reclaiming(self.info)
 
     def _start_drain_watch(self) -> None:
         """Watch the drain-intent KV prefix so ``llmctl drain <id>`` can
@@ -351,6 +377,77 @@ class ServedInstance:
             _watch(), name=f"drain-watch-{self.info.instance_id}"
         )
 
+    def _start_reclaim_watch(self) -> None:
+        """Watch the reclaim-notice KV prefix so ``llmctl reclaim <id>
+        --grace-s N`` (or a platform agent writing the same key) can
+        trigger deadline-bounded reclaim without owning this worker's
+        lease. The value carries the grace window as JSON."""
+        import json
+
+        drt = self.endpoint.drt
+
+        async def _watch() -> None:
+            key = f"{RECLAIM_PREFIX}{self.info.instance_id}"
+            try:
+                async for snapshot in drt.discovery.kv_watch_prefix(
+                    RECLAIM_PREFIX
+                ):
+                    if key not in snapshot:
+                        continue
+                    grace_s = DEFAULT_RECLAIM_GRACE_S
+                    with contextlib.suppress(Exception):
+                        raw = snapshot[key]
+                        if isinstance(raw, (bytes, bytearray)):
+                            raw = raw.decode()
+                        grace_s = float(json.loads(raw).get("grace_s", grace_s))
+                    await self.reclaim(grace_s)
+                    # Consume the notice (same hygiene as the drain key).
+                    with contextlib.suppress(Exception):
+                        await drt.discovery.kv_delete(key)
+                    return
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a broken control-plane watch
+                # must not kill serving; reclaim stays reachable via
+                # ServedInstance.reclaim() in-process (SIGTERM path).
+                logger.debug(
+                    "reclaim watch for instance %d ended",
+                    self.info.instance_id,
+                    exc_info=True,
+                )
+
+        self._reclaim_task = drt.spawn_background(
+            _watch(), name=f"reclaim-watch-{self.info.instance_id}"
+        )
+
+    async def reclaim(self, grace_s: float = DEFAULT_RECLAIM_GRACE_S) -> None:
+        """Spot-reclamation notice: republish this instance with
+        ``reclaiming`` (and ``draining``, so every legacy gate holds) in
+        its discovery metadata — routers and the KV aggregator stop
+        sending work within one watch event — then hand the grace
+        window to :attr:`on_reclaim` for in-flight triage
+        (docs/fault_tolerance.md "Spot reclamation & live migration")."""
+        if self.info.metadata.get("reclaiming"):
+            return
+        from ..telemetry import get_telemetry
+
+        self.info.metadata = {
+            **self.info.metadata,
+            "reclaiming": True,
+            "reclaim_grace_s": grace_s,
+            "draining": True,
+        }
+        await self.endpoint.drt.discovery.register_instance(self.info, self.lease)
+        get_telemetry().reclaim_events.labels("notice").inc()
+        logger.warning(
+            "instance %d reclaiming (endpoint %s, grace %.1fs)",
+            self.info.instance_id,
+            self.endpoint.path,
+            grace_s,
+        )
+        if self.on_reclaim is not None:
+            await self.on_reclaim(grace_s)
+
     async def drain(self) -> None:
         """Signal drain: republish this instance with ``draining`` set in
         its discovery metadata. Routers stop sending new work on their
@@ -381,6 +478,9 @@ class ServedInstance:
         if self._drain_task is not None:
             self._drain_task.cancel()
             self._drain_task = None
+        if self._reclaim_task is not None:
+            self._reclaim_task.cancel()
+            self._reclaim_task = None
         if revoke_lease is None:
             revoke_lease = self.lease is not drt._primary_lease
         if revoke_lease and self.lease.is_valid():

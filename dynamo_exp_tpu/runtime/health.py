@@ -34,9 +34,20 @@ logger = logging.getLogger(__name__)
 # truthy value as "no new work".
 DRAINING_KEY = "draining"
 
+# Metadata key a spot-reclaimed worker publishes (docs/fault_tolerance.md
+# "Spot reclamation & live migration"): same routing consequence as
+# draining — no new work within one watch event — but the window is a
+# hard platform deadline, not a goodbye the worker controls, so the
+# reclaim plane additionally triages in-flight sequences under it.
+RECLAIMING_KEY = "reclaiming"
+
 
 def is_draining(info: InstanceInfo) -> bool:
     return bool(info.metadata.get(DRAINING_KEY))
+
+
+def is_reclaiming(info: InstanceInfo) -> bool:
+    return bool(info.metadata.get(RECLAIMING_KEY))
 
 
 class BreakerState(enum.Enum):
@@ -216,10 +227,10 @@ class HealthTracker:
 
     # ----------------------------------------------------------- queries
     def is_available(self, info: InstanceInfo) -> bool:
-        """Routable right now: not draining, not breaker-blocked, not
-        stale. Does NOT claim the half-open probe slot — selection does
-        that via :meth:`acquire`."""
-        if is_draining(info):
+        """Routable right now: not draining, not reclaiming, not
+        breaker-blocked, not stale. Does NOT claim the half-open probe
+        slot — selection does that via :meth:`acquire`."""
+        if is_draining(info) or is_reclaiming(info):
             return False
         entry = self._instances.get(info.instance_id)
         if entry is None:

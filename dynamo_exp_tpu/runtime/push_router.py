@@ -336,7 +336,17 @@ class PushRouter(AsyncEngine[dict, Any]):
                 f"recovery (stream broke: {err})"
             ) from err
         journal.recoveries += 1
-        reason = "drain" if "drain" in str(err).lower() else "stream_drop"
+        # Cause attribution: a spot-reclaimed worker's break counts as
+        # "reclaim" (it also says "drain"-adjacent things, so test
+        # reclaim first); a drain-grace expiry as "drain"; anything else
+        # as a plain stream drop.
+        msg = str(err).lower()
+        if "reclaim" in msg:
+            reason = "reclaim"
+        elif "drain" in msg:
+            reason = "drain"
+        else:
+            reason = "stream_drop"
         get_telemetry().request_recoveries.labels(reason).inc()
         cont = journal.continuation_request()
         tried = set(broken)

@@ -140,6 +140,32 @@ class ChaosSchedule:
             )
         )
 
+    def reclaim_at(
+        self,
+        after_tokens: int,
+        instance_id: int | None = None,
+        grace_s: float = 1.0,
+        times: int = 1,
+    ) -> "ChaosSchedule":
+        """A spot reclamation landing mid-stream: the platform takes the
+        instance back after ``after_tokens`` tokens, cutting the
+        connection with a reclaim-tagged message (``grace_s`` rides the
+        message for the log) so recovery telemetry labels the failover
+        ``reclaim`` and the journal continuation resumes on a survivor
+        (docs/fault_tolerance.md "Spot reclamation & live migration")."""
+        return self.add(
+            Fault(
+                "request",
+                instance_id=instance_id,
+                times=times,
+                after_tokens=after_tokens,
+                message=(
+                    f"chaos: instance reclaimed mid-stream "
+                    f"(grace {grace_s:g}s)"
+                ),
+            )
+        )
+
     def fail_watch(self, times: int = 1) -> "ChaosSchedule":
         return self.add(Fault("watch", times=times, message="chaos: watch broke"))
 

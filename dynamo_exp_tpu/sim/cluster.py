@@ -48,6 +48,7 @@ from ..kv_router.scheduler import (
     NoWorkersError,
     ProcessedEndpoints,
 )
+from ..parallel.multihost import TopologyCoordinate
 from ..planner.planner import PlannerConfig
 from ..planner.policy import (
     PlannerObservation,
@@ -56,6 +57,12 @@ from ..planner.policy import (
     arm_decode_grace,
     plan_step,
     plan_step_slo,
+)
+from ..runtime.reclaim import (
+    MIGRATE,
+    SequenceSnapshot,
+    SurvivorInfo,
+    plan_triage,
 )
 from ..telemetry.slo import SloAttribution, SloConfig
 from .core import EventLoop
@@ -107,6 +114,26 @@ class SimConfig:
     # Fleet.
     initial_instances: int = 1
     provision_s: float | None = None  # None -> service model's value
+    # Spot reclamation (docs/fault_tolerance.md "Spot reclamation &
+    # live migration"): the last ceil(initial * spot_fraction) initial
+    # instances run on spot capacity and are reclaimed by a seeded
+    # exponential schedule at reclaim_rate_per_min, each with
+    # reclaim_grace_s of warning. In-flight sequences triage through
+    # the REAL runtime.reclaim.plan_triage planner: live KV migration
+    # (billed at migration_bw_bps over kv_bytes_per_page, sequential
+    # out of the dying host) lands the prefix on the topology-nearest
+    # survivor as admission cache credit; everything else rides the
+    # journal (full re-prefill on the least-loaded survivor). A
+    # reclaimed spot instance respawns after provision_s, and spot
+    # chip-seconds bill at spot_cost_factor — billed_chip_seconds is
+    # the "fraction of the cost" claim.
+    spot_fraction: float = 0.0
+    reclaim_rate_per_min: float = 0.0
+    reclaim_grace_s: float = 5.0
+    reclaim_margin_s: float = 0.25
+    migration_bw_bps: float = 100e6
+    kv_bytes_per_page: int = 2 << 20
+    spot_cost_factor: float = 0.3
     # Planner: None (fixed fleet) | "reactive" | "slo".
     planner: str | None = None
     planner_cfg: PlannerConfig | None = None
@@ -135,6 +162,9 @@ class _SimSeq:
         # preemption-limbo start (0 = not preempted-waiting), and when
         # this life's decode began (0 = still prefilling).
         "admitted_at", "preempted_at", "decode_began",
+        # Spot reclamation: True while this life is a live-migrated
+        # continuation whose cache credit is still unconsumed.
+        "migrated",
     )
 
     def __init__(self, req: SimRequest, now: float):
@@ -173,6 +203,7 @@ class _SimSeq:
         self.admitted_at = 0.0
         self.preempted_at = 0.0
         self.decode_began = 0.0
+        self.migrated = False
 
 
 class _SimInstance:
@@ -180,6 +211,7 @@ class _SimInstance:
         "id", "cfg", "waiting", "bound", "stall_queue", "pages_free",
         "metrics", "draining", "prefix_index", "shared_refs", "parked",
         "born_at", "preemptions", "host_free", "swap_queue",
+        "spot", "topo",
     )
 
     def __init__(self, iid: int, cfg: SimConfig, now: float):
@@ -196,6 +228,12 @@ class _SimInstance:
         # FIFO of proactively offloaded rows awaiting swap-in.
         self.host_free = cfg.host_pages_per_instance
         self.swap_queue: list[_SimSeq] = []
+        # Spot reclamation: capacity class, and a deterministic modeled
+        # topology coordinate (4 hosts per slice) so the triage
+        # planner's topology-nearest selector has real distances to
+        # fold in.
+        self.spot = False
+        self.topo = TopologyCoordinate(slice_id=iid // 4, host=iid % 4)
         # Prefix sharing (docs/prefix_sharing.md): the SAME radix index
         # the live page manager matches against, over synthetic per-
         # group block chains; refcounts per resident block, plus the
@@ -275,6 +313,12 @@ class ClusterSim:
         )
         self._chip_seconds = 0.0
         self._chips_since = 0.0
+        # Spot reclamation: its own rng stream (adding a reclaim draw
+        # must never perturb routing or service times), billed cost
+        # accounting, and the count of spot respawns in flight.
+        self._rng_reclaim = random.Random(cfg.seed ^ 0x5B07)
+        self._billed_chip_seconds = 0.0
+        self._provisioning_spot = 0
         # Request-anatomy rollup (telemetry/anatomy.py component names;
         # SimReport.anatomy): sim-clock component totals across all
         # requests, accumulated at admission / prefill-done / preempt /
@@ -292,8 +336,15 @@ class ClusterSim:
         self._prefix_chains: dict[int, list[int]] = {}
         self._shared_resident = 0  # live + parked shared blocks, fleet-wide
         self.event_log: list[str] = []
-        for _ in range(max(cfg.initial_instances, 1)):
-            self._spawn_ready()
+        n_init = max(cfg.initial_instances, 1)
+        n_spot = (
+            max(min(round(n_init * cfg.spot_fraction), n_init), 1)
+            if cfg.spot_fraction > 0
+            else 0
+        )
+        for i in range(n_init):
+            # The LAST n_spot initial instances are spot capacity.
+            self._spawn_ready(spot=i >= n_init - n_spot)
         self._resize_admission()
 
     # ------------------------------------------------------------ logging
@@ -413,14 +464,25 @@ class ClusterSim:
 
     def _account_chips(self) -> None:
         now = self.loop.now
-        self._chip_seconds += self._chips() * (now - self._chips_since)
+        dt = now - self._chips_since
+        self._chip_seconds += self._chips() * dt
+        # Billed cost: spot time (live spot instances + spot respawns
+        # in flight) at spot_cost_factor, the rest at on-demand parity.
+        n_spot = (
+            sum(1 for i in self.instances.values() if i.spot)
+            + self._provisioning_spot
+        )
+        self._billed_chip_seconds += (
+            (self._chips() - n_spot) + n_spot * self.cfg.spot_cost_factor
+        ) * dt
         self._chips_since = now
 
-    def _spawn_ready(self) -> _SimInstance:
+    def _spawn_ready(self, spot: bool = False) -> _SimInstance:
         self._account_chips()
         iid = self._next_iid
         self._next_iid += 1
         inst = _SimInstance(iid, self.cfg, self.loop.now)
+        inst.spot = spot
         self.instances[iid] = inst
         self.report.max_instances = max(
             self.report.max_instances, len(self.instances)
@@ -622,8 +684,12 @@ class ClusterSim:
             seq.state = SeqState.PREFILL
             inst.bound.append(seq)
             prefill_tokens = seq.prompt_len
-            if seq.cached_tokens and seq.preemptions == 0:
+            # Cache credit applies on first admission (router overlap)
+            # or when a live migration just parked this life's prefix on
+            # this instance; the credit is consumed here exactly once.
+            if seq.cached_tokens and (seq.preemptions == 0 or seq.migrated):
                 prefill_tokens = max(seq.prompt_len - seq.cached_tokens, 1)
+            seq.migrated = False
             delay = cfg.service.prefill_time(
                 prefill_tokens, self.rng_service
             )
@@ -975,6 +1041,260 @@ class ClusterSim:
             if inst.draining and inst.idle and len(self.instances) > 1:
                 self._retire(inst)
 
+    # ---------------------------------------------------- spot reclamation
+    def _start_reclaims(self) -> None:
+        if self.cfg.spot_fraction > 0 and self.cfg.reclaim_rate_per_min > 0:
+            self._schedule_next_reclaim()
+
+    def _schedule_next_reclaim(self) -> None:
+        rate = self.cfg.reclaim_rate_per_min / 60.0
+        self.loop.after(
+            self._rng_reclaim.expovariate(rate), self._on_reclaim_tick
+        )
+
+    def _on_reclaim_tick(self) -> None:
+        spot_ids = sorted(
+            iid
+            for iid, inst in self.instances.items()
+            if inst.spot and not inst.draining
+        )
+        # Reclaim only while a survivor exists: a platform can take the
+        # whole fleet, but the study's question is survival, not
+        # annihilation.
+        if spot_ids and len(self._routable()) > 1:
+            iid = spot_ids[self._rng_reclaim.randrange(len(spot_ids))]
+            self._begin_reclaim(self.instances[iid])
+        if self._fleet_busy():
+            self._schedule_next_reclaim()
+
+    def _gen_progress(self, seq: _SimSeq) -> int:
+        """Tokens this round has produced by now — the same elapsed/itl
+        banking rule as :meth:`_preempt`."""
+        gen = seq.gen_round
+        if (
+            seq.state is SeqState.ACTIVE
+            and not seq.stalled
+            and not seq.swapped
+            and seq.itl > 0
+        ):
+            gen = min(
+                max(
+                    int((self.loop.now - seq.decode_start) / seq.itl),
+                    seq.gen_round,
+                ),
+                seq.round_budget,
+            )
+        return gen if seq.state is not SeqState.WAITING else 0
+
+    def _detach(self, seq: _SimSeq) -> int:
+        """Remove the sequence from its instance, banking decode
+        progress into its continuation prompt (delivered tokens are
+        final — the journal guarantees no loss, no duplication).
+        Returns tokens banked."""
+        inst = seq.instance
+        gen = self._gen_progress(seq)
+        if seq.swapped:
+            seq.swapped = False
+            inst.host_free += seq.swap_pages
+            seq.swap_pages = 0
+            if seq in inst.swap_queue:
+                inst.swap_queue.remove(seq)
+        seq.epoch += 1
+        seq.delivered += gen
+        seq.prompt_len += gen
+        seq.remaining -= gen
+        if seq in inst.bound:
+            inst.pages_free += seq.pages - seq.shared_page_count
+            self._release_shared(inst, seq)
+            seq.pages = 0
+            inst.bound.remove(seq)
+        else:
+            self._remove_waiting(inst, seq)
+        if seq.stalled:
+            seq.stalled = False
+            inst.stall_queue.remove(seq)
+        if seq.decode_began:
+            self._anatomy["decode_compute"] += self.loop.now - seq.decode_began
+            seq.decode_began = 0.0
+        if not seq.preempted_at:
+            seq.preempted_at = self.loop.now  # limbo until re-admission
+        seq.state = SeqState.WAITING
+        seq.instance = None
+        seq.cached_tokens = 0
+        seq.migrated = False
+        return gen
+
+    def _least_loaded(self) -> "_SimInstance | None":
+        ready = self._routable()
+        if not ready:
+            return None
+        return min(ready, key=lambda i: (len(i.bound) + len(i.waiting), i.id))
+
+    def _requeue_on(self, seq: _SimSeq, dest: _SimInstance) -> None:
+        seq.instance = dest
+        dest.waiting.append(seq)
+        self._pump(dest)
+
+    def _failover(self, seq: _SimSeq) -> None:
+        """Journal failover: the continuation re-prefills its whole
+        context on the least-loaded survivor (queue-depth routing, the
+        recovery router's behavior)."""
+        self._detach(seq)
+        self.report.reclaim_failovers += 1
+        dest = self._least_loaded()
+        if dest is None:
+            self._log("req %d reclaim failover found no survivor", seq.req.index)
+            self._finish(seq, "error")
+            return
+        self._log(
+            "req %d failover -> inst %d (%d tok banked)",
+            seq.req.index, dest.id, seq.delivered,
+        )
+        self._requeue_on(seq, dest)
+
+    def _begin_reclaim(self, inst: _SimInstance) -> None:
+        """A reclaim notice landed: flip the instance out of routing
+        (the live metadata republish) and run the REAL triage planner
+        over its in-flight work."""
+        if inst.draining:
+            return
+        inst.draining = True
+        cfg = self.cfg
+        grace = cfg.reclaim_grace_s
+        self.report.reclaims += 1
+        survivors = [
+            SurvivorInfo(
+                instance=f"sim-{i.id}", instance_id=i.id, topology=i.topo
+            )
+            for i in self.instances.values()
+            if i is not inst and not i.draining
+        ]
+        snaps: list[SequenceSnapshot] = []
+        by_rid: dict[str, _SimSeq] = {}
+        ps = cfg.page_size
+        for seq in list(inst.bound):
+            gen = self._gen_progress(seq)
+            # Live-engine bound: only complete pages of confirmed
+            # tokens ship; swapped rows' KV is not device-resident.
+            full = (
+                max(0, (seq.prompt_len + gen - 1) // ps)
+                if seq.state is SeqState.ACTIVE and not seq.swapped
+                else 0
+            )
+            snap = SequenceSnapshot(
+                request_id=str(seq.req.index),
+                priority=seq.priority,
+                full_pages=full,
+                kv_bytes=full * cfg.kv_bytes_per_page,
+                tokens_generated=seq.delivered + gen,
+            )
+            snaps.append(snap)
+            by_rid[snap.request_id] = seq
+        plan = plan_triage(
+            snaps,
+            survivors,
+            grace,
+            origin=f"sim-{inst.id}",
+            origin_topo=inst.topo,
+            margin_s=cfg.reclaim_margin_s,
+            est_fn=lambda _s, _d, nb: nb / cfg.migration_bw_bps,
+        )
+        n_mig = sum(1 for d in plan if d.action == MIGRATE)
+        self._log(
+            "reclaim notice inst %d (grace %.2fs): %d migrate, %d failover",
+            inst.id, grace, n_mig, len(plan) - n_mig,
+        )
+        for d in plan:
+            seq = by_rid[d.seq.request_id]
+            if d.action == MIGRATE:
+                self._detach(seq)
+                self.report.reclaim_migrated += 1
+                self.report.reclaim_migrated_pages += d.seq.full_pages
+                self._log(
+                    "req %d migrate inst %d -> inst %d (%d pages, eta %.3fs)",
+                    seq.req.index, inst.id, d.dest.instance_id,
+                    d.seq.full_pages, d.eta_s,
+                )
+                self.loop.after(
+                    d.eta_s,
+                    self._on_migrate_landed,
+                    seq,
+                    d.dest.instance_id,
+                    d.seq.full_pages,
+                    seq.epoch,
+                )
+            else:
+                self._failover(seq)
+        for seq in list(inst.waiting):
+            # Never started here: plain reroute, nothing to ship.
+            self._failover(seq)
+        self.loop.after(grace, self._on_reclaim_kill, inst.id)
+
+    def _on_migrate_landed(
+        self, seq: _SimSeq, dest_id: int, full_pages: int, epoch: int
+    ) -> None:
+        if seq.epoch != epoch or seq.state is not SeqState.WAITING:
+            return
+        dest = self.instances.get(dest_id)
+        if dest is None or dest.draining:
+            # The survivor itself died mid-transfer: the journal still
+            # owns correctness — plain failover, cache credit lost.
+            self.report.reclaim_migrated -= 1
+            self.report.reclaim_migrated_pages -= full_pages
+            self.report.reclaim_failovers += 1
+            self._log(
+                "req %d migration target inst %d gone; journal failover",
+                seq.req.index, dest_id,
+            )
+            fallback = self._least_loaded()
+            if fallback is None:
+                self._finish(seq, "error")
+                return
+            self._requeue_on(seq, fallback)
+            return
+        # The shipped prefix parked in dest's cache: the continuation
+        # admits with that many tokens of prefill credit.
+        seq.migrated = True
+        seq.cached_tokens = min(
+            full_pages * self.cfg.page_size, seq.prompt_len - 1
+        )
+        self._log(
+            "req %d migration landed on inst %d (%d tok cached)",
+            seq.req.index, dest.id, seq.cached_tokens,
+        )
+        self._requeue_on(seq, dest)
+
+    def _on_reclaim_kill(self, iid: int) -> None:
+        inst = self.instances.get(iid)
+        if inst is None:
+            return
+        # Triage displaced everything at the notice; anything that
+        # landed since (it can't — the instance left routing) or was
+        # missed degrades to failover rather than dying with the host.
+        for seq in list(inst.bound) + list(inst.waiting):
+            self._failover(seq)
+        was_spot = inst.spot
+        self._retire(inst)
+        self._log("instance %d reclaimed", iid)
+        if was_spot and self._fleet_busy():
+            # The spot pool refills: same capacity class, fresh host.
+            self._account_chips()
+            self._provisioning += 1
+            self._provisioning_spot += 1
+            delay = (
+                self.cfg.provision_s
+                if self.cfg.provision_s is not None
+                else self.cfg.service.provision_s
+            )
+            self.loop.after(delay, self._on_spot_ready)
+            self._log("instance provisioning (spot respawn)")
+
+    def _on_spot_ready(self) -> None:
+        self._account_chips()
+        self._provisioning -= 1
+        self._provisioning_spot -= 1
+        self._spawn_ready(spot=True)
+
     # ------------------------------------------------------------- planner
     def _start_planner(self) -> None:
         if self.cfg.planner is None:
@@ -1060,6 +1380,7 @@ class ClusterSim:
         self._chips_since = self.loop.now
         self._schedule_next_arrival()
         self._start_planner()
+        self._start_reclaims()
         self.loop.run(max_events=self.cfg.max_events)
         self._account_chips()
         r = self.report
@@ -1077,6 +1398,7 @@ class ClusterSim:
         )
         r.wall_clock_s = round(time.perf_counter() - t0, 3)  # dynlint: determinism(host-only wall-clock report field)
         r.chip_seconds = round(self._chip_seconds, 3)
+        r.billed_chip_seconds = round(self._billed_chip_seconds, 3)
         if r.duration_s > 0:
             r.goodput_tok_s = round(r.completed_tokens / r.duration_s, 3)
         # SLO attribution totals (shared telemetry/slo.py code path —

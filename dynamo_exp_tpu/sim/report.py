@@ -68,6 +68,17 @@ class SimReport:
     ttft_p99_s: float | None = None
     itl_p50_s: float | None = None
     itl_p99_s: float | None = None
+    # Spot reclamation (docs/fault_tolerance.md "Spot reclamation &
+    # live migration"): reclaim notices served, sequences live-migrated
+    # (KV prefix shipped, resumed with cache credit) vs journal
+    # failovers (full re-prefill), pages shipped, and chip-seconds at
+    # billed cost (spot time × spot_cost_factor) — goodput per
+    # billed_chip_second is the spot-fleet economics headline.
+    reclaims: int = 0
+    reclaim_migrated: int = 0
+    reclaim_failovers: int = 0
+    reclaim_migrated_pages: int = 0
+    billed_chip_seconds: float = 0.0
     max_instances: int = 0
     chip_seconds: float = 0.0
     events: int = 0

@@ -156,11 +156,29 @@ def compare_bench(
                     Finding(metric, fld, a, b, round(change, 4), kind)
                 )
 
-        if old.get("unit") == "tok/s" and isinstance(
+        unit = str(old.get("unit") or "")
+        if unit.endswith("tok/s") and isinstance(
             new.get("value"), (int, float)
         ) and isinstance(old.get("value"), (int, float)):
-            judge("value(tok/s)", float(old["value"]), float(new["value"]),
+            judge(f"value({unit})", float(old["value"]), float(new["value"]),
                   higher_is_worse=False)
+        # Spot-reclamation sweep fields (bench.py --reclaim-sweep):
+        # billed chip-seconds are the spot-economics denominator
+        # (growing spend at equal goodput is a regression), the
+        # migrated fraction is the live-migration hit rate (falling
+        # means more journal re-prefill), and goodput per billed
+        # chip-second is the headline ratio the sweep exists for.
+        for fld, worse_high in (
+            ("billed_chip_seconds", True),
+            ("migrated_fraction", False),
+            ("goodput_per_billed_chip_s", False),
+        ):
+            a_v, b_v = old.get(fld), new.get(fld)
+            if isinstance(a_v, (int, float)) and isinstance(
+                b_v, (int, float)
+            ):
+                judge(fld, float(a_v), float(b_v),
+                      higher_is_worse=worse_high)
         lat_old, lat_new = _latency_fields(old), _latency_fields(new)
         for fld in sorted(set(lat_old) & set(lat_new)):
             judge(fld, lat_old[fld], lat_new[fld], higher_is_worse=True)

@@ -28,6 +28,7 @@ by construction.
 
 from __future__ import annotations
 
+import os
 import re
 import threading
 import time
@@ -37,6 +38,22 @@ from dataclasses import dataclass, field
 # the estimate by this fraction, so a link's number settles within a
 # handful of transfers but one straggler doesn't erase the history.
 BW_EWMA_ALPHA = 0.3
+
+# Cold-start bandwidth prior for never-observed links (bytes/s):
+# ~100 MB/s, well under any healthy host-bounce TCP link, so an
+# unmeasured path is priced pessimistically until real transfers teach
+# the ledger otherwise. Override per deployment with DYN_KV_DEFAULT_BW_BPS.
+DEFAULT_LINK_BANDWIDTH_BPS = 100e6
+
+
+def _env_bw(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
 
 # Tolerant key aliases: engine ``metrics()`` snapshots and parsed
 # ``/metrics`` Prometheus text spell the same quantity differently.
@@ -94,9 +111,20 @@ class TransferLedger:
     floats; nothing here ever touches a device value.
     """
 
-    def __init__(self):
+    def __init__(self, default_bandwidth_bps: float | None = None):
         self._lock = threading.Lock()
         self._links: dict[tuple[str, str], LinkStats] = {}
+        # Cold-start prior: `estimate_transfer_s` on a never-observed
+        # link answers with this bandwidth instead of None, so reclaim
+        # triage and the decode selector get a finite cost on a fresh
+        # fleet (first transfer hasn't landed yet). Deliberately
+        # conservative — a modest host-bounce TCP figure — so cold links
+        # look *expensive* until measured, never free.
+        if default_bandwidth_bps is None:
+            default_bandwidth_bps = _env_bw(
+                "DYN_KV_DEFAULT_BW_BPS", DEFAULT_LINK_BANDWIDTH_BPS
+            )
+        self.default_bandwidth_bps = float(default_bandwidth_bps)
 
     def record(
         self, src: str, dst: str, n_bytes: int, duration_s: float
@@ -148,11 +176,15 @@ class TransferLedger:
         self, src: str, dst: str, n_bytes: int
     ) -> float | None:
         """Predicted wall time to move ``n_bytes`` over the link — the
-        number the topology-aware decode selector folds into its score.
-        None when the link has never been observed (the caller falls
-        back to its topology prior)."""
+        number the topology-aware decode selector and reclaim triage
+        fold into their scores. A never-observed link answers at
+        ``default_bandwidth_bps`` (cold-start prior) instead of None, so
+        a fresh fleet's first triage never divides by zero; None only
+        when the prior itself is disabled (<= 0)."""
         bw = self.bandwidth_bps(src, dst)
         if bw is None:
+            bw = self.default_bandwidth_bps
+        if bw <= 0:
             return None
         return n_bytes / bw
 

@@ -284,7 +284,7 @@ class Telemetry:
         self.request_recoveries = Counter(
             "dynamo_request_recoveries_total",
             "Mid-stream failovers resumed on a different instance",
-            ["reason"],  # stream_drop | drain
+            ["reason"],  # stream_drop | drain | reclaim
             registry=self.registry,
         )
         self.tokens_deduplicated = Counter(
@@ -295,6 +295,34 @@ class Telemetry:
         self.kv_lease_reclaims = Counter(
             "dynamo_kv_lease_reclaims_total",
             "KV pages reclaimed from expired disagg handoff leases",
+            registry=self.registry,
+        )
+        # Spot reclamation (docs/fault_tolerance.md "Spot reclamation &
+        # live migration"): the reclaim plane's lifecycle — notice
+        # received, per-sequence triage outcomes (live migration vs
+        # journal failover, with deadline degradations counted
+        # separately), and the KV pages actually shipped to survivors.
+        self.reclaim_events = Counter(
+            "dynamo_reclaim_events_total",
+            "Spot-reclamation lifecycle events: notice (metadata "
+            "flipped to reclaiming), migrated / failover (per-sequence "
+            "triage outcomes), deadline_degraded (a planned migration "
+            "fell back to journal failover at the grace deadline), "
+            "completed (triage finished inside the grace window)",
+            ["event"],  # notice|migrated|failover|deadline_degraded|completed
+            registry=self.registry,
+        )
+        self.reclaim_migrated_pages = Counter(
+            "dynamo_reclaim_migrated_pages_total",
+            "KV pages live-migrated to survivor instances during "
+            "spot reclamation",
+            registry=self.registry,
+        )
+        self.reclaim_triage_seconds = Histogram(
+            "dynamo_reclaim_triage_seconds",
+            "Wall time of one reclaim triage pass (notice to last "
+            "migration confirm) — must beat the grace window",
+            buckets=_STAGE_BUCKETS,
             registry=self.registry,
         )
         # Overload protection (docs/fault_tolerance.md "Overload

@@ -59,7 +59,15 @@ def test_ledger_records_links_and_estimates_bandwidth():
     assert bw_ab == pytest.approx((1 << 20) / 0.1)
     assert led.estimate_transfer_s("a", "c", 2 << 20) == pytest.approx(0.1)
     assert led.bandwidth_bps("a", "zz") is None
-    assert led.estimate_transfer_s("a", "zz", 100) is None
+    # Never-observed links price at the cold-start prior (reclaim triage
+    # must cost transfers on a fresh fleet); only a disabled prior
+    # leaves them unpriceable — tests/test_reclaim.py covers the knob.
+    assert led.estimate_transfer_s("a", "zz", 100) == pytest.approx(
+        100 / led.default_bandwidth_bps
+    )
+    assert TransferLedger(default_bandwidth_bps=0).estimate_transfer_s(
+        "a", "zz", 100
+    ) is None
     # EWMA: a second, slower observation moves the estimate toward it
     # without erasing the history.
     led.record("a", "b", 1 << 20, 0.2)
