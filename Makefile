@@ -32,7 +32,7 @@ TIERING_SEED_SETS := 7,21,1337 3,9,27
 # degrade to journal failover with zero lost/duplicated tokens.
 RECLAIM_SEED_SETS := 7,21,1337 5,8,13
 
-.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare anatomy-smoke
+.PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare anatomy-smoke tune-smoke
 
 test:
 	$(PYTEST) tests/ -q -m "not tpu and not weekly"
@@ -152,3 +152,13 @@ anatomy-smoke:
 		--trace-file tests/fixtures/anatomy_trace.jsonl -n 5
 	env JAX_PLATFORMS=cpu python -m dynamo_exp_tpu.llmctl fingerprint \
 		tests/fixtures/anatomy_trace.jsonl
+
+# Autotuner smoke (docs/tuning.md): `llmctl tune` against the
+# checked-in workload-fingerprint fixture — seeded search over the
+# knob registry must beat the registry defaults in-sim (--check exits
+# nonzero otherwise), and the journal/space digest must stay
+# deterministic for the fixed seed. Runs pre-merge (pre-merge.yml).
+tune-smoke:
+	env JAX_PLATFORMS=cpu python -m dynamo_exp_tpu.llmctl tune \
+		--fingerprint tests/fixtures/tune_fingerprint.json \
+		--budget 96 --seed 0 --check --json

@@ -91,7 +91,14 @@ CPU_FALLBACK = False
 # can tell warm samples from cold (the manifest hash pins which compile
 # lattice produced the numbers).
 PREWARM = False
-LINE_TAGS = {"prewarmed": False, "manifest_hash": None}
+LINE_TAGS = {
+    "prewarmed": False,
+    "manifest_hash": None,
+    # Resolved tunable-knob dict + stable hash (tune/space.py), filled
+    # in by _build_engine; None until an engine exists.
+    "knobs": None,
+    "config_hash": None,
+}
 
 
 def _preset(name: str):
@@ -168,18 +175,26 @@ def _enable_compile_cache() -> None:
 
 def _build_engine(cfg, params=None, seed: int = 0):
     """Every bench engine goes through here: tags each subsequent JSON
-    line with the engine's compile-manifest hash and whether it was
-    warm-booted (``--prewarm``), so ``sim/fit.py`` can split warm from
-    cold samples (docs/aot.md)."""
+    line with the engine's compile-manifest hash, whether it was
+    warm-booted (``--prewarm``), and the resolved tunable-knob dict
+    plus its stable ``config_hash`` (tune/space.py) — so
+    ``llmctl bench compare`` pairs lines knobbed identically instead of
+    silently comparing differently-tuned runs, and ``sim/fit.py`` can
+    split warm from cold samples (docs/aot.md, docs/tuning.md)."""
     from dynamo_exp_tpu.aot import manifest_for_engine
     from dynamo_exp_tpu.engine import TPUEngine
+    from dynamo_exp_tpu.tune import space as tune_space
 
     engine = TPUEngine(cfg, params=params, seed=seed)
     manifest = manifest_for_engine(engine)
     if PREWARM:
         engine.prewarm(manifest)
+    knobs = tune_space.resolved_engine_knobs(cfg)
     LINE_TAGS.update(
-        prewarmed=bool(PREWARM), manifest_hash=manifest.hash()
+        prewarmed=bool(PREWARM),
+        manifest_hash=manifest.hash(),
+        knobs=knobs,
+        config_hash=tune_space.config_hash(knobs),
     )
     engine.start()
     return engine

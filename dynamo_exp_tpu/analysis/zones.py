@@ -90,6 +90,14 @@ DETERMINISM_ZONES: tuple[Zone, ...] = (
             "survivors_from_instances",
         ),
     ),
+    # The autotuner (docs/tuning.md): the trial journal is the resume
+    # contract — same seed + same target must rewrite it byte-identical
+    # — and the knob-space digest is a cache key, so search, space, and
+    # artifact assembly must be free of wall clocks and unseeded draws.
+    # The live-validation stage necessarily times a real engine; its
+    # reads are inline-waived ("live validation wall-clock
+    # measurement").
+    Zone("dynamo_exp_tpu/tune/"),
 )
 
 # ------------------------------------------------- thread-ownership model

@@ -13,13 +13,63 @@ from dataclasses import dataclass, field
 from ..models.config import ModelConfig
 
 
-def _env_flag(name: str) -> bool | None:
-    """Tri-state env toggle: None when unset/empty, else truthiness
-    (the DYN_SPEC spelling rules: 1/true/on/yes vs 0/false/no/off)."""
-    v = os.environ.get(name, "").strip().lower()
-    if not v:
-        return None
-    return v in ("1", "true", "on", "yes")
+_TRUTHY = frozenset({"1", "true", "on", "yes"})
+_FALSY = frozenset({"0", "false", "no", "off"})
+
+# One validated table for every engine-config env knob. Each entry:
+# (env name, config attr, kind). Kinds:
+#   "flag"  — tri-state bool: truthy/falsy spelling sets the attr,
+#             unset/empty leaves the config value alone, anything else
+#             raises (a typo'd spelling must not silently no-op);
+#   "grace" — DYN_KV_PROACTIVE: truthy arms proactive offload (grace
+#             clamped >= 0), falsy disables it (-1.0);
+#   "spec"  — DYN_SPEC: truthy -> the "ngram" drafter, falsy -> stay
+#             off, any other value must be a registered drafter name
+#             (the PR 7 falsy-spelling bug class, now structural: every
+#             spelling is validated at construction).
+_ENV_KNOBS: tuple[tuple[str, str, str], ...] = (
+    ("DYN_SPEC", "spec_mode", "spec"),
+    ("DYN_KV_PACKING", "kv_packing", "flag"),
+    ("DYN_KV_PREFETCH", "kv_prefetch", "flag"),
+    ("DYN_KV_PROACTIVE", "proactive_offload_grace_s", "grace"),
+)
+# Env-name families this table owns: any OTHER name under these
+# prefixes is a typo (DYN_KV_PACKNG=1 must fail loudly, not silently
+# bench the wrong baseline) — except names owned by other modules.
+_ENV_FAMILIES = ("DYN_KV_", "DYN_SPEC")
+_ENV_EXEMPT = frozenset({
+    "DYN_KV_DEFAULT_BW_BPS",  # telemetry.fleet: link-bandwidth prior
+})
+
+
+def _env_knob_names() -> tuple[str, ...]:
+    return tuple(name for name, _, _ in _ENV_KNOBS)
+
+
+def _check_unknown_env_knobs() -> None:
+    """Reject unknown names in the owned DYN_* families, listing the
+    accepted spellings."""
+    accepted = set(_env_knob_names()) | _ENV_EXEMPT
+    for name in os.environ:
+        if name in accepted:
+            continue
+        if any(name.startswith(fam) for fam in _ENV_FAMILIES):
+            raise ValueError(
+                f"unknown engine env knob {name!r}; accepted: "
+                f"{', '.join(sorted(accepted))}"
+            )
+
+
+def _parse_env_flag(name: str, raw: str) -> bool:
+    low = raw.strip().lower()
+    if low in _TRUTHY:
+        return True
+    if low in _FALSY:
+        return False
+    raise ValueError(
+        f"{name}={raw!r} is not a recognized flag spelling; accepted: "
+        f"{', '.join(sorted(_TRUTHY))} / {', '.join(sorted(_FALSY))}"
+    )
 
 
 def default_prefill_buckets(max_len: int) -> list[int]:
@@ -200,32 +250,7 @@ class EngineConfig:
         self.prefill_buckets = sorted(set(self.prefill_buckets))
         if self.kv_dtype not in ("bfloat16", "float32"):
             raise ValueError(f"unsupported kv_dtype: {self.kv_dtype!r}")
-        env = os.environ.get("DYN_SPEC", "").strip()
-        if env and self.spec_mode == "off":
-            # Env toggle for whole suites (`make chaos` SPEC_SEED_SETS):
-            # flips speculation on for every engine the process builds
-            # without touching call sites; an explicit spec_mode wins.
-            # Falsy spellings stay off — DYN_SPEC=0 after a chaos run
-            # must not be parsed as a drafter name and crash startup.
-            low = env.lower()
-            if low in ("1", "true", "on"):
-                self.spec_mode = "ngram"
-            elif low not in ("0", "false", "no", "off"):
-                self.spec_mode = env
-        # Predictive-tiering env toggles (suite-wide A/B without call-
-        # site changes; an explicit falsy spelling turns a policy off).
-        for env_name, attr in (
-            ("DYN_KV_PACKING", "kv_packing"),
-            ("DYN_KV_PREFETCH", "kv_prefetch"),
-        ):
-            flag = _env_flag(env_name)
-            if flag is not None:
-                setattr(self, attr, flag)
-        flag = _env_flag("DYN_KV_PROACTIVE")
-        if flag is not None:
-            self.proactive_offload_grace_s = (
-                max(self.proactive_offload_grace_s, 0.0) if flag else -1.0
-            )
+        self._apply_env_knobs()
         if self.spec_max_draft < self.spec_min_draft or self.spec_min_draft < 1:
             raise ValueError(
                 f"bad spec draft bounds [{self.spec_min_draft}, "
@@ -234,6 +259,45 @@ class EngineConfig:
         self.spec_draft_len = min(
             max(self.spec_draft_len, self.spec_min_draft), self.spec_max_draft
         )
+
+    def _apply_env_knobs(self) -> None:
+        """Walk the validated env-knob table (suite-wide A/B toggles —
+        `make chaos` SPEC_SEED_SETS etc. — without touching call
+        sites). Unknown names in the owned DYN_* families and
+        malformed values raise here, at construction, with the
+        accepted spellings listed."""
+        _check_unknown_env_knobs()
+        for name, attr, kind in _ENV_KNOBS:
+            raw = os.environ.get(name, "").strip()
+            if not raw:
+                continue
+            if kind == "flag":
+                setattr(self, attr, _parse_env_flag(name, raw))
+            elif kind == "grace":
+                if _parse_env_flag(name, raw):
+                    self.proactive_offload_grace_s = max(
+                        self.proactive_offload_grace_s, 0.0
+                    )
+                else:
+                    self.proactive_offload_grace_s = -1.0
+            else:  # "spec"
+                if self.spec_mode != "off":
+                    continue  # an explicit spec_mode wins
+                low = raw.lower()
+                if low in _TRUTHY:
+                    self.spec_mode = "ngram"
+                elif low not in _FALSY:
+                    from ..spec import registered_drafters
+
+                    names = registered_drafters()
+                    if raw not in names:
+                        raise ValueError(
+                            f"{name}={raw!r} is neither a flag spelling "
+                            f"nor a registered drafter; accepted: "
+                            f"{', '.join(sorted(_TRUTHY | _FALSY))} / "
+                            f"{', '.join(sorted(names))}"
+                        )
+                    self.spec_mode = raw
 
     @property
     def kv_dtype_jnp(self):

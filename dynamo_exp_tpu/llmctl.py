@@ -494,6 +494,81 @@ def build_parser() -> argparse.ArgumentParser:
     aot.add_argument("--no-lp", action="store_true",
                      help="drop the logprob variants (halves the lattice "
                      "for deployments that never serve logprobs)")
+
+    # Sim-in-the-loop autotuner (docs/tuning.md): seeded coordinate
+    # descent over the declarative knob space, scored in the cluster
+    # simulator against a workload target, optionally live-validated on
+    # the tiny harness, emitted as a bootable config artifact.
+    tune = sub.add_parser(
+        "tune", help="autotune engine/planner knobs against a workload "
+                     "target (offline, seeded)"
+    )
+    tgt = tune.add_mutually_exclusive_group(required=True)
+    tgt.add_argument(
+        "--fingerprint", default="",
+        help="target workload fingerprint JSON "
+             "(`llmctl fingerprint --out`)",
+    )
+    tgt.add_argument(
+        "--trace", default="",
+        help="target a sim workload trace JSONL (tuned via its "
+             "fingerprint)",
+    )
+    tgt.add_argument(
+        "--workload", default="",
+        choices=("burst", "ramp", "diurnal", "users"),
+        help="target a named synthetic workload",
+    )
+    tune.add_argument("--seed", type=int, default=0)
+    tune.add_argument("--budget", type=int, default=64,
+                      help="max sim evaluations (rung-0 + rung-1)")
+    tune.add_argument("--eval-seeds", type=int, default=2,
+                      help="seeds per full evaluation")
+    tune.add_argument("--requests", type=int, default=None,
+                      help="requests per evaluation (default: the "
+                           "fingerprint's own n)")
+    tune.add_argument("--rate-rps", type=float, default=None,
+                      help="override the target's arrival rate")
+    tune.add_argument("--instances", type=int, default=1,
+                      help="modeled fleet size the knobs are tuned for")
+    tune.add_argument(
+        "--planner", action="store_true",
+        help="run the SLO planner in every evaluation and include the "
+             "planner/SLO knobs in the search space",
+    )
+    tune.add_argument("--journal", default="",
+                      help="JSONL trial journal path (audit + resume)")
+    tune.add_argument(
+        "--resume", action="store_true",
+        help="replay an existing --journal as an evaluation cache "
+             "(byte-identical continuation of an interrupted run)",
+    )
+    tune.add_argument(
+        "--top-k", type=int, default=0,
+        help="validate this many top candidates on the live tiny "
+             "harness (sim-vs-live rank agreement) before recommending "
+             "(0 = skip; boots real engines)",
+    )
+    tune.add_argument("--out", default="",
+                      help="write the tuned-config artifact JSON here")
+    tune.add_argument("--preset", default="tiny",
+                      help="model preset the artifact's engine block "
+                           "and AOT manifest are built for")
+    tune.add_argument("--max-model-len", type=int, default=512)
+    tune.add_argument("--kv-dtype", default="bfloat16",
+                      choices=["bfloat16", "float32"])
+    tune.add_argument("--tp", type=int, default=1)
+    tune.add_argument(
+        "--no-manifest", action="store_true",
+        help="skip embedding the AOT CompileManifest in the artifact",
+    )
+    tune.add_argument(
+        "--check", action="store_true",
+        help="exit nonzero unless the recommendation beats the default "
+             "config in-sim (the `make tune-smoke` gate)",
+    )
+    tune.add_argument("--json", action="store_true",
+                      help="print the result summary as JSON")
     return p
 
 
@@ -943,6 +1018,156 @@ async def run_aot(args) -> int:
     return 0
 
 
+async def run_tune(args) -> int:
+    """The autotuner plane (docs/tuning.md): search in the simulator,
+    optionally validate top-K on the live tiny harness, emit the
+    bootable config artifact."""
+    from .tune import artifact as tune_artifact
+    from .tune import search as tune_search
+    from .tune import validate as tune_validate
+
+    fp = None
+    if args.fingerprint:
+        from .telemetry.fingerprint import load_fingerprint
+
+        fp = load_fingerprint(args.fingerprint)
+        target = tune_search.target_from_fingerprint(
+            fp, requests=args.requests, rate_rps=args.rate_rps
+        )
+    elif args.trace:
+        target = tune_search.target_from_trace(
+            args.trace, requests=args.requests, rate_rps=args.rate_rps
+        )
+        fp = target.fingerprint
+    else:
+        target = tune_search.TuneTarget(
+            kind="synthetic",
+            name=args.workload,
+            requests=args.requests or 64,
+            rate_rps=args.rate_rps,
+        )
+
+    settings = tune_search.SearchSettings(
+        seed=args.seed,
+        budget=args.budget,
+        eval_seeds=args.eval_seeds,
+        planner=args.planner,
+        base_sim={"initial_instances": args.instances},
+    )
+    result = tune_search.run_search(
+        target,
+        settings,
+        journal_path=args.journal or None,
+        resume=args.resume,
+    )
+    summary = {
+        "target": result.target_digest,
+        "seed": result.seed,
+        "trials": result.trials,
+        "best_overrides": result.best_overrides,
+        "best_score": result.best_score,
+        "default_score": result.default_score,
+        "improvement": result.improvement,
+    }
+
+    validation = None
+    if args.top_k > 0:
+        candidates = tune_search.top_candidates(result, args.top_k)
+        report = await tune_validate.validate_candidates(
+            candidates, target, seed=args.seed
+        )
+        validation = {
+            "kendall_tau": report["kendall_tau"],
+            "top1_agreement": report["top1_agreement"],
+            "agreed": report["agreed"],
+            "sim_scores": report["sim_scores"],
+            "live_scores": report["live_scores"],
+        }
+        summary["validation"] = validation
+
+    if args.out:
+        manifest = None
+        if not args.no_manifest:
+            import jax
+
+            from .aot import build_manifest
+            from .engine import EngineConfig, resolve_attn_impl
+            from .models import PRESETS
+            from .parallel.mesh import build_mesh
+
+            mcfg = PRESETS[args.preset]
+            max_len = min(args.max_model_len, mcfg.max_position_embeddings)
+            shape = {
+                "max_model_len": max_len,
+                "kv_dtype": args.kv_dtype,
+                "tp": args.tp,
+            }
+            cfg = EngineConfig(
+                model=mcfg,
+                eos_token_ids=[],
+                **shape,
+                **tune_artifact.resolved_live_knobs(result.best_overrides),
+            )
+            mesh = build_mesh(tp=cfg.tp, sp=cfg.sp)
+            impl, interpret = resolve_attn_impl(cfg, mesh)
+            manifest = build_manifest(
+                cfg, attn_impl=impl, mesh_shape=dict(mesh.shape),
+                jax_version=jax.__version__, interpret=interpret,
+            )
+        else:
+            shape = {
+                "max_model_len": args.max_model_len,
+                "kv_dtype": args.kv_dtype,
+                "tp": args.tp,
+            }
+        art = tune_artifact.build_artifact(
+            result,
+            preset=args.preset,
+            shape=shape,
+            manifest=manifest,
+            fingerprint=fp,
+            validation=validation,
+        )
+        tune_artifact.write_artifact(art, args.out)
+        summary["artifact"] = args.out
+        summary["config_hash"] = art["config_hash"]
+
+    if args.json:
+        print(json.dumps(summary, indent=2, sort_keys=True))
+    else:
+        print(
+            f"target {summary['target']}  trials {summary['trials']}  "
+            f"score {summary['best_score']} vs default "
+            f"{summary['default_score']} "
+            f"({summary['improvement']:+.1%})"
+        )
+        for k, v in sorted(result.best_overrides.items()):
+            print(f"  {k} = {v}")
+        if validation is not None:
+            print(
+                f"validation: kendall_tau {validation['kendall_tau']}, "
+                f"top-1 {'agrees' if validation['top1_agreement'] else 'DISAGREES'}"
+            )
+        if args.out:
+            print(f"artifact -> {args.out}")
+
+    if validation is not None and not validation["agreed"]:
+        print(
+            "tune: sim-vs-live validation DISAGREES; recommendation "
+            "not trustworthy",
+            file=sys.stderr,
+        )
+        return 1
+    if args.check and result.best_score <= result.default_score:
+        print(
+            "tune --check: recommendation does not beat the default "
+            "config in-sim",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def run_audit(args) -> int:
     """Render the KV conservation audit carried by a flight dump's
     snapshot: the per-state page counts, the verdict, and — on a
@@ -1256,6 +1481,8 @@ async def run(args) -> int:
         return run_slow_offline(args)
     if args.plane == "aot":  # offline: compile lattice, no cluster
         return await run_aot(args)
+    if args.plane == "tune":  # offline: sim search (+ local tiny harness)
+        return await run_tune(args)
     if args.plane == "lint":  # offline: AST checks, no cluster
         from .analysis.runner import run_cli
 

@@ -9,12 +9,14 @@ Reference parity: ``/root/reference/examples/llm/components/planner.py``
 from .connector import LocalConnector, PlannerConnector
 from .planner import Planner, PlannerConfig
 from .policy import (
+    CatalogEntry,
     Decision,
     PlannerObservation,
     PlannerState,
     ScaleAction,
     SloTargets,
     arm_decode_grace,
+    maybe_swap_config,
     plan_step,
     plan_step_slo,
 )
@@ -27,9 +29,11 @@ __all__ = [
     "PlannerObservation",
     "PlannerState",
     "ScaleAction",
+    "CatalogEntry",
     "Decision",
     "SloTargets",
     "arm_decode_grace",
+    "maybe_swap_config",
     "plan_step",
     "plan_step_slo",
 ]

@@ -79,6 +79,11 @@ class PlannerConfig:
     # optional p99 measurements ride in where a caller (the simulator,
     # or an embedder with latency histograms) provides them.
     slo: "SloTargets | None" = None
+    # Pre-validated tuned configs (policy.CatalogEntry tuple, emitted
+    # by ``llmctl tune``): when the live fingerprint drifts past
+    # DRIFT_ALERT_THRESHOLD, plan_step_slo swaps to the nearest entry
+    # (docs/tuning.md "Catalog swap").
+    config_catalog: tuple = ()
 
 
 class Planner:
@@ -117,6 +122,13 @@ class Planner:
         # (cleared with the interval: absent means no signal).
         self.ttft_p99_s: float | None = None
         self.itl_p99_s: float | None = None
+        # Fingerprint-plane inputs for the catalog swap, set by an
+        # embedder wiring a WorkloadDriftWatch: live drift score vs the
+        # pinned reference, and the live fingerprint itself. Unlike the
+        # per-interval samples these are NOT reset each round — the
+        # drift watch is a continuously maintained signal.
+        self.drift_score: float | None = None
+        self.live_fingerprint = None
         # SLO attribution source (telemetry.SloAttribution, usually the
         # HTTP edge's): each adjustment round pulls its p99 pressure
         # inputs from the attribution window and resets it — so
@@ -223,6 +235,8 @@ class Planner:
             ttft_p99_s=self.ttft_p99_s,
             itl_p99_s=self.itl_p99_s,
             now=self._clock(),
+            drift_score=self.drift_score,
+            fingerprint=self.live_fingerprint,
         )
 
     async def make_adjustments_with_counts(
@@ -254,6 +268,8 @@ class Planner:
             )
         for note in decision.notes:
             logger.info("%s", note)
+        if decision.config_swap is not None:
+            self._apply_config_swap(decision.config_swap)
         for action in decision.actions:
             apply = (
                 self.connector.add_component
@@ -270,6 +286,32 @@ class Planner:
                     # Only a decode worker that actually spawned earns
                     # scale-down protection.
                     self._plan_state = arm_decode_grace(self._plan_state)
+
+    def _apply_config_swap(self, swap: dict) -> None:
+        """Record a catalog swap: adjustment-log entry (the op the sim
+        report also carries), ``dynamo_config_swaps_total`` bump, and a
+        ``config_swap`` trace span so the flight/trace timeline shows
+        when — and why — the fleet changed configs."""
+        from ..telemetry import get_telemetry, span
+
+        entry = {
+            "op": "config_swap",
+            "name": swap["name"],
+            "config_hash": swap["config_hash"],
+            "drift_before": swap["drift_before"],
+            "drift_after": swap["drift_after"],
+        }
+        self.adjustments.append(entry)
+        logger.info("planner action: %s", entry)
+        get_telemetry().config_swaps.inc()
+        with span(
+            "config_swap",
+            name=swap["name"],
+            config_hash=swap["config_hash"],
+            drift_before=swap["drift_before"],
+            drift_after=swap["drift_after"],
+        ):
+            pass
 
     def _log_action(self, op: str, component: str, signal: float) -> None:
         entry = {"op": op, "component": component, "signal": round(signal, 4)}

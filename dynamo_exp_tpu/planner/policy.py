@@ -38,6 +38,12 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 
+from ..telemetry.fingerprint import (
+    DRIFT_ALERT_THRESHOLD,
+    WorkloadFingerprint,
+    drift_score,
+)
+
 # Number of adjustment intervals a new decode worker is protected from
 # scale-down (reference: planner.py:42).
 NEW_DECODE_WORKER_GRACE_PERIOD = 3
@@ -64,6 +70,11 @@ class PlannerObservation:
     ttft_p99_s: float | None = None
     itl_p99_s: float | None = None
     now: float = 0.0
+    # Workload-fingerprint plane (PR 16): drift of live traffic vs the
+    # pinned reference, and the live fingerprint itself. ``None`` means
+    # the fingerprint plane isn't wired — the catalog swap stays off.
+    drift_score: float | None = None
+    fingerprint: WorkloadFingerprint | None = None
 
 
 @dataclass(frozen=True)
@@ -73,6 +84,24 @@ class PlannerState:
     the simulator snapshot/replay planner state."""
 
     decode_grace_remaining: int = 0
+    # Name of the config-catalog entry currently in force ("" = the
+    # deployment default). Folded by the catalog swap in
+    # :func:`plan_step_slo`.
+    active_config: str = ""
+
+
+@dataclass(frozen=True)
+class CatalogEntry:
+    """One pre-validated tuned config the planner may swap to when
+    live traffic drifts (``llmctl tune`` emits these; docs/tuning.md).
+    ``overrides`` is a tuple of ``(knob, value)`` pairs — hashable, so
+    the entry stays frozen; ``config_hash`` is the tune artifact's
+    stable knob hash (the same one bench lines are stamped with)."""
+
+    name: str
+    fingerprint: WorkloadFingerprint
+    overrides: tuple = ()
+    config_hash: str = ""
 
 
 @dataclass(frozen=True)
@@ -100,6 +129,12 @@ class Decision:
     # actually lands — arming on a failed add would protect a worker
     # that never existed from scale-down for the whole grace period.
     arm_decode_grace: bool = False
+    # Catalog swap proposed this round (``plan_step_slo`` only):
+    # {"name", "config_hash", "drift_before", "drift_after",
+    # "overrides"}. The caller records the flight/trace event and bumps
+    # ``dynamo_config_swaps_total``; the new active entry is already
+    # folded into the returned PlannerState.
+    config_swap: dict | None = None
 
 
 def arm_decode_grace(state: PlannerState) -> PlannerState:
@@ -110,8 +145,57 @@ def arm_decode_grace(state: PlannerState) -> PlannerState:
     return PlannerState(
         decode_grace_remaining=max(
             state.decode_grace_remaining, NEW_DECODE_WORKER_GRACE_PERIOD - 1
-        )
+        ),
+        active_config=state.active_config,
     )
+
+
+def maybe_swap_config(
+    obs: PlannerObservation, state: PlannerState, cfg
+) -> tuple[dict | None, str, list[str]]:
+    """The catalog-swap decision: when live drift vs the pinned
+    reference crosses :data:`DRIFT_ALERT_THRESHOLD` (the same number
+    the fleet doctor flags DRIFT on), pick the catalog entry whose
+    fingerprint is nearest the live one — and swap only if it is
+    strictly nearer than the current drift (swapping to an equally
+    wrong config would just churn). Pure: returns (swap-or-None,
+    new-active-name, notes)."""
+    catalog = tuple(getattr(cfg, "config_catalog", ()) or ())
+    if (
+        not catalog
+        or obs.fingerprint is None
+        or obs.drift_score is None
+        or obs.drift_score < DRIFT_ALERT_THRESHOLD
+    ):
+        return None, state.active_config, []
+    scored = sorted(
+        (drift_score(obs.fingerprint, e.fingerprint), e.name, e)
+        for e in catalog
+    )
+    best_d, _, best = scored[0]
+    if best.name == state.active_config:
+        return (
+            None,
+            state.active_config,
+            [f"drift {obs.drift_score:.2f} but {best.name!r} already active"],
+        )
+    if best_d >= obs.drift_score:
+        return (
+            None,
+            state.active_config,
+            [
+                f"drift {obs.drift_score:.2f}: no catalog entry nearer "
+                f"(best {best.name!r} at {best_d:.2f})"
+            ],
+        )
+    swap = {
+        "name": best.name,
+        "config_hash": best.config_hash,
+        "drift_before": obs.drift_score,
+        "drift_after": best_d,
+        "overrides": dict(best.overrides),
+    }
+    return swap, best.name, []
 
 
 def _mean(samples: tuple[float, ...]) -> float | None:
@@ -200,7 +284,7 @@ def plan_step(
         grace -= 1
     return (
         Decision(tuple(actions), tuple(notes), arm_decode_grace=arm),
-        PlannerState(grace),
+        PlannerState(grace, active_config=state.active_config),
     )
 
 
@@ -278,6 +362,10 @@ def plan_step_slo(
         obs.num_prefill * cfg.prefill_engine_num_tpu
         + obs.num_decode * cfg.decode_engine_num_tpu
     )
+
+    # --------------------------------------------------- catalog swap
+    swap, active, swap_notes = maybe_swap_config(obs, state, cfg)
+    notes.extend(swap_notes)
 
     def clamp_pressure(x: float) -> float:
         return min(max(x, 0.0), slo.max_pressure)
@@ -377,6 +465,11 @@ def plan_step_slo(
     if grace > 0:
         grace -= 1
     return (
-        Decision(tuple(actions), tuple(notes), arm_decode_grace=arm),
-        PlannerState(grace),
+        Decision(
+            tuple(actions),
+            tuple(notes),
+            arm_decode_grace=arm,
+            config_swap=swap,
+        ),
+        PlannerState(grace, active_config=active),
     )

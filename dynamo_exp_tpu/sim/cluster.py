@@ -109,6 +109,12 @@ class SimConfig:
     # the host tier (restore billed at restore_s_per_page) instead of
     # the row being preempted. 0 host pages = reactive baseline.
     kv_packing: bool = True
+    # Mirrors of EngineConfig.packing_scan_limit / packing_max_defers
+    # (the autotuner tunes them through the shared knob registry,
+    # tune/space.py): waiting-queue prefix scanned per packing pass,
+    # and bypasses before a deferred sequence becomes a barrier.
+    packing_scan_limit: int = 16
+    packing_max_defers: int = 64
     host_pages_per_instance: int = 0
     proactive_offload: bool = True
     # Fleet.
@@ -605,7 +611,7 @@ class ClusterSim:
         cand = []
         entries = []
         for i, s in enumerate(inst.waiting):
-            if i >= 16:
+            if i >= self.cfg.packing_scan_limit:
                 break
             total = footprint_pages(s.prompt_len, s.remaining, ps)
             resident = 0
@@ -619,7 +625,9 @@ class ClusterSim:
             fits = max(total - resident, 0) <= inst.pages_free
             cand.append(s)
             entries.append((fits, s.priority, s.packing_defers))
-        idx = select_packed_index(entries, max_defers=64)
+        idx = select_packed_index(
+            entries, max_defers=self.cfg.packing_max_defers
+        )
         if idx is None or idx == 0:
             return inst.waiting[0]
         for s in cand[:idx]:

@@ -118,14 +118,32 @@ def compare_bench(
 
     Only metrics present in BOTH captures compare; a platform-tag
     mismatch (chip vs CPU-fallback line) skips the pair with a note.
-    Throughput compares on ``value`` for ``tok/s`` lines (lower = worse);
-    every ``*ttft*``/``*itl*`` latency field compares too (higher =
-    worse)."""
+    Lines carrying a ``config_hash`` (tune/space.py knob stamp) pair by
+    (metric, config_hash) — a run knobbed differently is a different
+    experiment, skipped rather than flagged as a regression; untagged
+    legacy lines keep pairing by metric alone. Throughput compares on
+    ``value`` for ``tok/s`` lines (lower = worse); every
+    ``*ttft*``/``*itl*`` latency field compares too (higher = worse)."""
     report = CompareReport()
     by_metric = {ln["metric"]: ln for ln in old_lines}
+    by_config = {
+        (ln["metric"], ln["config_hash"]): ln
+        for ln in old_lines
+        if ln.get("config_hash")
+    }
     for new in new_lines:
-        old = by_metric.get(new["metric"])
+        old = by_config.get((new["metric"], new.get("config_hash")))
         if old is None:
+            old = by_metric.get(new["metric"])
+        if old is None:
+            continue
+        h_old = old.get("config_hash")
+        h_new = new.get("config_hash")
+        if h_old and h_new and h_old != h_new:
+            report.skipped.append(
+                f"{new['metric']}: knob config {h_old} vs {h_new} — "
+                f"differently-tuned runs, not comparable"
+            )
             continue
         p_old = old.get("platform")
         p_new = new.get("platform")

@@ -366,6 +366,13 @@ def _tv_distance(a: tuple, b: tuple) -> float:
     return 0.5 * sum(abs(x - y) for x, y in zip(pa, pb))
 
 
+# One threshold, three consumers: the fleet doctor's DRIFT flag, the
+# planner's config-catalog swap trigger, and docs/tuning.md all key on
+# the same number — drift past it means "the pinned reference no longer
+# describes live traffic, act".
+DRIFT_ALERT_THRESHOLD = 0.25
+
+
 def drift_score(live: WorkloadFingerprint, ref: WorkloadFingerprint) -> float:
     """Normalized [0, 1] distance between two fingerprints — the
     ``dynamo_workload_drift_score`` value. Equal-weight mean over the

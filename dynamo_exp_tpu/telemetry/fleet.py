@@ -34,6 +34,8 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from .fingerprint import DRIFT_ALERT_THRESHOLD
+
 # EWMA weight for the per-link bandwidth estimate: new observations move
 # the estimate by this fraction, so a link's number settles within a
 # handful of transfers but one straggler doesn't erase the history.
@@ -569,7 +571,7 @@ def render_top(view: FleetView) -> str:
                 flags.append(f"LEDGER!{m.ledger_violations}")
             if name in roll["config_skew"]:
                 flags.append("SKEW")
-            if m.workload_drift >= 0.25:
+            if m.workload_drift >= DRIFT_ALERT_THRESHOLD:
                 flags.append(f"DRIFT:{m.workload_drift:.2f}")
             lines.append(
                 f"{name:<{name_w}}  {m.running:3d} {m.waiting:4d}  "

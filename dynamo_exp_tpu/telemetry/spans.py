@@ -471,6 +471,13 @@ class Telemetry:
             "0 when no reference is pinned",
             registry=self.registry,
         )
+        self.config_swaps = Counter(
+            "dynamo_config_swaps",
+            "Planner config-catalog swaps: drift past the alert "
+            "threshold moved the fleet onto a different pre-validated "
+            "tuned config (docs/tuning.md)",
+            registry=self.registry,
+        )
         # Fleet observability plane (docs/observability.md "Fleet
         # plane"): the KV conservation auditor's violation counter (0 in
         # any healthy run — a nonzero value names a page-accounting bug,

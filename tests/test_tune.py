@@ -1,0 +1,628 @@
+"""Sim-in-the-loop autotuner (docs/tuning.md).
+
+Covers the four tune/ stages plus the surfaces the tuner reaches into:
+
+1. **Registry walk** — the knob space and the owning config dataclasses
+   cannot drift: every scalar field is registered or explicitly
+   ``NON_TUNABLE``, every registered default sits on its grid, and the
+   docs knob table is the generated one.
+2. **Search determinism** — same seed ⇒ bit-identical JSONL journal;
+   different seeds diverge; a truncated journal resumes into the
+   byte-identical uninterrupted journal; a journal from a different
+   run is refused.
+3. **Held-out improvement** — the checked-in fingerprint fixture tunes
+   to a config that beats the registry defaults on seeds provably
+   outside the search's evaluation-seed family.
+4. **Sim-vs-live validation** — contrasting candidates rank the same
+   in the simulator and on a live tiny engine (Kendall tau + top-1).
+5. **Artifact** — round-trips through JSON, boots an engine whose
+   resolved knobs hash to the artifact's ``config_hash``, and a warm
+   boot from the artifact's manifest compiles nothing.
+6. **Catalog swap** — ``maybe_swap_config`` threshold gating, nearest-
+   entry selection, and churn protection, inside ``plan_step_slo``.
+7. **Env-knob table** — ``DYN_*`` flag spellings validate at config
+   construction; typos and malformed values raise, exempt names pass.
+8. **Bench pairing** — ``llmctl bench compare`` pairs by
+   ``(metric, config_hash)`` and skips differently-tuned runs.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+from dynamo_exp_tpu.models import TINY
+from dynamo_exp_tpu.parallel import single_device_mesh
+from dynamo_exp_tpu.planner.planner import PlannerConfig
+from dynamo_exp_tpu.planner.policy import (
+    CatalogEntry,
+    PlannerObservation,
+    PlannerState,
+    SloTargets,
+    maybe_swap_config,
+    plan_step,
+    plan_step_slo,
+)
+from dynamo_exp_tpu.telemetry.bench_compare import compare_bench
+from dynamo_exp_tpu.telemetry.fingerprint import (
+    DRIFT_ALERT_THRESHOLD,
+    WorkloadFingerprint,
+    drift_score,
+    load_fingerprint,
+)
+from dynamo_exp_tpu.tune import (
+    SearchSettings,
+    TuneResult,
+    TuneTarget,
+    build_artifact,
+    catalog_entry_from_artifact,
+    engine_config_from_artifact,
+    evaluate,
+    kendall_tau,
+    load_artifact,
+    manifest_from_artifact,
+    run_search,
+    target_from_fingerprint,
+    top_candidates,
+    validate_candidates,
+    write_artifact,
+)
+from dynamo_exp_tpu.tune import space
+from dynamo_exp_tpu.tune.artifact import resolved_live_knobs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "tune_fingerprint.json")
+
+
+# ------------------------------------------------------------- registry
+@pytest.mark.pre_merge
+def test_registry_covers_every_scalar_config_field():
+    """The registry-walk guard: every bool/int/float field of each
+    owning config dataclass is either a registered knob or explicitly
+    allowlisted in NON_TUNABLE — and the two sets never overlap or go
+    stale. Adding a config field without deciding its tunability fails
+    here."""
+    from dataclasses import MISSING, fields
+
+    for owner, cls in space.owner_classes().items():
+        scalar = {
+            f.name
+            for f in fields(cls)
+            if f.default is not MISSING
+            and isinstance(f.default, (bool, int, float))
+        }
+        registered = {k.name for k in space.KNOBS if k.owner == owner}
+        allowed = space.NON_TUNABLE[owner]
+        missing = scalar - registered - allowed
+        assert not missing, (
+            f"{owner}: undecided fields {sorted(missing)} — register a "
+            f"Knob or allowlist in NON_TUNABLE with a reason"
+        )
+        assert not registered & allowed, (
+            f"{owner}: both registered and allowlisted: "
+            f"{sorted(registered & allowed)}"
+        )
+        assert not allowed - scalar, (
+            f"{owner}: stale NON_TUNABLE entries: {sorted(allowed - scalar)}"
+        )
+        assert not registered - scalar, (
+            f"{owner}: registered knobs with no matching scalar field: "
+            f"{sorted(registered - scalar)}"
+        )
+
+
+@pytest.mark.pre_merge
+def test_registry_defaults_sit_on_their_grids():
+    for knob in space.KNOBS:
+        assert space.default_value(knob) in knob.grid, (
+            f"{knob.name}: dataclass default {space.default_value(knob)!r} "
+            f"not on grid {knob.grid}"
+        )
+
+
+@pytest.mark.pre_merge
+def test_knob_table_doc_sync():
+    """docs/tuning.md carries the generated knob table verbatim — the
+    same discipline as the telemetry metric and dynlint waiver doc
+    guards."""
+    with open(os.path.join(REPO, "docs", "tuning.md")) as f:
+        doc = f.read()
+    assert space.render_knob_table() in doc, (
+        "docs/tuning.md knob table is stale; paste the output of "
+        "space.render_knob_table()"
+    )
+
+
+@pytest.mark.pre_merge
+def test_config_hash_canonical_and_discriminating():
+    knobs = space.defaults("engine")
+    h = space.config_hash(knobs)
+    assert h == space.config_hash(dict(reversed(list(knobs.items()))))
+    changed = dict(knobs, max_decode_slots=knobs["max_decode_slots"] * 2)
+    assert space.config_hash(changed) != h
+    assert len(h) == 12 and len(space.space_digest()) == 16
+
+
+@pytest.mark.pre_merge
+def test_override_mapping_helpers():
+    with pytest.raises(KeyError):
+        space.split_overrides({"not_a_knob": 1})
+    over = {"max_decode_slots": 16, "max_inflight": 32, "decode_window": 8}
+    sim_kw = space.sim_kwargs_from_overrides(over)
+    # Engine knobs map through their SimConfig mirror; sim-only knobs
+    # pass through; live-only knobs (decode_window) are dropped.
+    assert sim_kw == {"slots_per_instance": 16, "max_inflight": 32}
+    eng_kw = space.engine_kwargs_from_overrides(over)
+    assert eng_kw == {"max_decode_slots": 16, "decode_window": 8}
+
+
+# --------------------------------------------------------------- search
+def _target(n=16) -> TuneTarget:
+    return TuneTarget(kind="synthetic", name="burst", requests=n)
+
+
+def _settings(**over) -> SearchSettings:
+    base = dict(
+        seed=3, budget=10, eval_seeds=2, base_sim={"initial_instances": 1}
+    )
+    return SearchSettings(**(base | over))
+
+
+def test_search_same_seed_bit_identical_journal(tmp_path):
+    pa, pb = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    ra = run_search(_target(), _settings(), journal_path=pa)
+    rb = run_search(_target(), _settings(), journal_path=pb)
+    with open(pa, "rb") as fa, open(pb, "rb") as fb:
+        assert fa.read() == fb.read()
+    assert ra.best_overrides == rb.best_overrides
+    assert ra.best_score == rb.best_score
+
+
+def test_search_different_seeds_diverge(tmp_path):
+    ra = run_search(_target(), _settings(seed=3))
+    rb = run_search(_target(), _settings(seed=4))
+    # Headers differ trivially (the seed is in them); the *trial*
+    # sequences must too — different seed means different evaluation
+    # seeds and a different coordinate order.
+    assert ra.journal[1:] != rb.journal[1:]
+
+
+def test_truncated_journal_resumes_byte_identical(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    run_search(_target(), _settings(), journal_path=path)
+    with open(path, "rb") as f:
+        full = f.read()
+    lines = full.decode().splitlines()
+    torn = "\n".join(lines[:5]) + '\n{"kind": "tri'  # half-written tail
+    with open(path, "w") as f:
+        f.write(torn)
+    run_search(_target(), _settings(), journal_path=path, resume=True)
+    with open(path, "rb") as f:
+        assert f.read() == full
+
+
+def test_resume_refuses_foreign_journal(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    run_search(_target(), _settings(seed=3), journal_path=path)
+    with pytest.raises(ValueError, match="different run"):
+        run_search(
+            _target(), _settings(seed=4), journal_path=path, resume=True
+        )
+
+
+@pytest.mark.pre_merge
+def test_top_candidates_distinct_rung1_best_first():
+    def trial(overrides, rung, score):
+        return {
+            "kind": "trial", "overrides": overrides, "rung": rung,
+            "score": score,
+        }
+
+    result = TuneResult(
+        best_overrides={}, best_score=3.0, default_score=1.0, trials=5,
+        journal=[
+            {"kind": "header"},
+            trial({}, 1, 1.0),
+            trial({"a": 1}, 0, 9.0),  # rung 0 never surfaces
+            trial({"a": 1}, 1, 3.0),
+            trial({"a": 1}, 1, 2.5),  # duplicate config, kept once
+            trial({"b": 2}, 1, 2.0),
+        ],
+        target_digest="t", seed=0,
+    )
+    assert top_candidates(result, 2) == [{"a": 1}, {"b": 2}]
+    assert top_candidates(result, 9) == [{"a": 1}, {"b": 2}, {}]
+
+
+def test_tuned_beats_defaults_on_held_out_seeds():
+    """The tune-smoke contract (make tune-smoke runs the CLI spelling):
+    searching the checked-in fingerprint fixture finds overrides that
+    beat the registry defaults on seeds outside the search's
+    ``seed*1000+i`` evaluation family."""
+    target = target_from_fingerprint(load_fingerprint(FIXTURE))
+    settings = SearchSettings(
+        seed=0, budget=96, eval_seeds=2, base_sim={"initial_instances": 1}
+    )
+    result = run_search(target, settings)
+    assert result.best_overrides, "search found nothing over the defaults"
+    assert result.improvement > 0
+    held_out = [777000, 777001, 777002]
+    tuned = sum(
+        evaluate(result.best_overrides, target, settings, s)["score"]
+        for s in held_out
+    )
+    default = sum(
+        evaluate({}, target, settings, s)["score"] for s in held_out
+    )
+    assert tuned > default, (
+        f"tuned {result.best_overrides} lost to defaults on held-out "
+        f"seeds: {tuned:.3f} <= {default:.3f}"
+    )
+
+
+# ----------------------------------------------------- sim-vs-live rank
+@pytest.mark.pre_merge
+def test_kendall_tau_units():
+    assert kendall_tau([1.0], [2.0]) == 1.0
+    assert kendall_tau([1.0, 2.0, 3.0], [10.0, 20.0, 30.0]) == 1.0
+    assert kendall_tau([1.0, 2.0, 3.0], [30.0, 20.0, 10.0]) == -1.0
+    assert kendall_tau([1.0, 1.0], [1.0, 2.0]) == 0.0  # tie contributes 0
+
+
+def test_sim_and_live_rank_agree_on_contrasting_candidates():
+    """The validation stage's own contract: a candidate that strangles
+    edge admission (``max_inflight=1`` sheds most of the burst) must
+    lose to the default envelope in BOTH the simulator and the live
+    tiny harness — same clamped workload on both sides, shedding for
+    the same causal reason, so only the configs differ. The target is
+    a long-prompt fingerprint (mass in the 64-128 ISL bucket) so the
+    burst genuinely overlaps inside the harness. The live SLO gates are
+    lifted out of the way: ranking here must come from goodput (24 vs
+    ~192 tokens over comparable wall time), not from whether this
+    host's cold-start compile stall happens to cross a fixed ITL gate."""
+    fp = WorkloadFingerprint(
+        n=48,
+        isl_hist=(0, 0, 0, 48, 0, 0, 0, 0, 0, 0, 0),
+        osl_hist=(0, 0, 0, 48, 0, 0, 0, 0, 0, 0, 0),
+        priority_mix=(0.0, 1.0, 0.0),
+        arrival_rate_rps=8.0,
+    )
+    target = target_from_fingerprint(fp)
+    candidates = [{}, {"max_inflight": 1}]
+    verdict = asyncio.run(
+        validate_candidates(
+            candidates, target, seed=5, n=8,
+            slo_ttft_s=1e9, slo_itl_s=1e9,
+        )
+    )
+    assert verdict["top1_agreement"] is True, verdict["candidates"]
+    assert verdict["kendall_tau"] == 1.0, verdict["candidates"]
+    assert verdict["agreed"] is True
+    assert verdict["sim_scores"][0] > verdict["sim_scores"][1]
+    assert verdict["live_scores"][0] > verdict["live_scores"][1]
+
+
+# ------------------------------------------------------------- artifact
+def _result(**over) -> TuneResult:
+    base = dict(
+        best_overrides={
+            "max_decode_slots": 2,
+            "num_pages": 64,
+            "page_size": 8,
+            "prefill_chunk": 16,
+            "decode_window": 4,
+        },
+        best_score=2.0, default_score=1.0, trials=7,
+        journal=[], target_digest="fixture", seed=0,
+    )
+    return TuneResult(**(base | over))
+
+
+async def _collect(engine, prompt, max_tokens=8):
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    b = BackendInput(token_ids=list(prompt))
+    b.stop_conditions.max_tokens = max_tokens
+    b.stop_conditions.ignore_eos = True
+    stream = await engine.generate(b.to_dict())
+    toks = []
+    async for item in stream:
+        toks.extend(item.get("token_ids", []))
+    return toks
+
+
+def test_artifact_round_trip_boots_with_zero_compiles(tmp_path):
+    """The emission contract: the artifact's resolved knobs hash to its
+    ``config_hash`` (the bench stamp), and a boot from the artifact's
+    manifest against a populated persistent cache compiles nothing."""
+    from dynamo_exp_tpu.aot import manifest_for_engine
+
+    fp = load_fingerprint(FIXTURE)
+    shape = {"max_model_len": 128, "kv_dtype": "float32"}
+    art0 = build_artifact(
+        _result(), preset="tiny", shape=shape, fingerprint=fp
+    )
+    probe = TPUEngine(
+        engine_config_from_artifact(art0, model=TINY),
+        mesh=single_device_mesh(), seed=0,
+    )
+    art = build_artifact(
+        _result(), preset="tiny", shape=shape,
+        manifest=manifest_for_engine(probe), fingerprint=fp,
+    )
+    path = str(tmp_path / "tuned.json")
+    write_artifact(art, path)
+    art = load_artifact(path)
+
+    cache = str(tmp_path / "cache")
+
+    def boot():
+        cfg = engine_config_from_artifact(art, model=TINY)
+        # The booted engine's resolved knobs ARE the artifact's hash —
+        # a bench run of this engine pairs against the tuned baseline.
+        assert (
+            space.config_hash(space.resolved_engine_knobs(cfg))
+            == art["config_hash"]
+        )
+        eng = TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+        eng.prewarm(manifest_from_artifact(art), cache_dir=cache)
+        toks = asyncio.run(_collect(eng, range(20, 36)))
+        m = eng.metrics()
+        eng.stop()
+        return m, toks
+
+    m1, toks1 = boot()
+    m2, toks2 = boot()
+    assert m2["dispatch"]["ragged"]["compile_misses"] == 0
+    assert m2["dispatch"]["ragged"]["compile_total_s"] == 0.0
+    assert toks1 == toks2
+
+    entry = catalog_entry_from_artifact(art, name="tuned-burst")
+    assert entry.name == "tuned-burst"
+    assert entry.config_hash == art["config_hash"]
+    assert dict(entry.overrides) == art["overrides"]
+    assert entry.fingerprint.digest() == fp.digest()
+
+
+@pytest.mark.pre_merge
+def test_artifact_guards():
+    art = build_artifact(_result(), preset="tiny")
+    assert art["fingerprint"] is None
+    with pytest.raises(ValueError, match="no target fingerprint"):
+        catalog_entry_from_artifact(art)
+    # Version check on load.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "a.json")
+        write_artifact(dict(art, version=99), path)
+        with pytest.raises(ValueError, match="version"):
+            load_artifact(path)
+
+
+# --------------------------------------------------------- catalog swap
+FP_A = WorkloadFingerprint(n=10, isl_hist=(10, 0), osl_hist=(10, 0))
+FP_B = WorkloadFingerprint(n=10, isl_hist=(0, 10), osl_hist=(0, 10))
+
+
+def _entry(name, fp, **over):
+    base = dict(
+        fingerprint=fp,
+        overrides=(("max_decode_slots", 32),),
+        config_hash="abc123def456",
+    )
+    return CatalogEntry(name=name, **(base | over))
+
+
+def _swap_obs(**over):
+    base = dict(
+        num_prefill=0, num_decode=2,
+        drift_score=drift_score(FP_B, FP_A), fingerprint=FP_B,
+    )
+    return PlannerObservation(**(base | over))
+
+
+def _pcfg(**over):
+    return PlannerConfig(**(dict(max_tpu_budget=8, min_endpoint=1) | over))
+
+
+@pytest.mark.pre_merge
+def test_swap_gating():
+    cfg = _pcfg(config_catalog=(_entry("b", FP_B),))
+    # The drifted fixture pair really is past the shared threshold.
+    assert drift_score(FP_B, FP_A) >= DRIFT_ALERT_THRESHOLD
+    # Below threshold: no-op, silently.
+    swap, active, notes = maybe_swap_config(
+        _swap_obs(drift_score=DRIFT_ALERT_THRESHOLD - 0.01),
+        PlannerState(), cfg,
+    )
+    assert swap is None and active == "" and notes == []
+    # No fingerprint plane wired: no-op.
+    swap, _, _ = maybe_swap_config(
+        _swap_obs(fingerprint=None), PlannerState(), cfg
+    )
+    assert swap is None
+    # Empty catalog: no-op.
+    swap, _, _ = maybe_swap_config(_swap_obs(), PlannerState(), _pcfg())
+    assert swap is None
+
+
+@pytest.mark.pre_merge
+def test_swap_picks_nearest_entry():
+    cfg = _pcfg(config_catalog=(_entry("a", FP_A), _entry("b", FP_B)))
+    swap, active, notes = maybe_swap_config(_swap_obs(), PlannerState(), cfg)
+    assert swap is not None and active == "b"
+    assert swap["name"] == "b"
+    assert swap["config_hash"] == "abc123def456"
+    assert swap["drift_after"] < swap["drift_before"]
+    assert swap["overrides"] == {"max_decode_slots": 32}
+
+
+@pytest.mark.pre_merge
+def test_swap_churn_protection():
+    # Already on the nearest entry: no swap, explained.
+    cfg = _pcfg(config_catalog=(_entry("b", FP_B),))
+    swap, active, notes = maybe_swap_config(
+        _swap_obs(), PlannerState(active_config="b"), cfg
+    )
+    assert swap is None and active == "b"
+    assert any("already active" in n for n in notes)
+    # Best entry no nearer than current drift: swapping would churn.
+    cfg = _pcfg(config_catalog=(_entry("a", FP_A),))
+    swap, active, notes = maybe_swap_config(_swap_obs(), PlannerState(), cfg)
+    assert swap is None and active == ""
+    assert any("no catalog entry nearer" in n for n in notes)
+
+
+@pytest.mark.pre_merge
+def test_plan_step_slo_folds_catalog_swap():
+    cfg = _pcfg(config_catalog=(_entry("b", FP_B),))
+    obs = _swap_obs(kv_load=(0.5, 0.5))
+    decision, state = plan_step_slo(obs, PlannerState(), cfg, SloTargets())
+    assert decision.config_swap is not None
+    assert decision.config_swap["name"] == "b"
+    assert state.active_config == "b"
+    # Next interval, same drift: the entry is active, no re-swap.
+    decision, state = plan_step_slo(obs, state, cfg, SloTargets())
+    assert decision.config_swap is None
+    assert state.active_config == "b"
+
+
+@pytest.mark.pre_merge
+def test_reactive_plan_step_carries_active_config():
+    decision, state = plan_step(
+        PlannerObservation(num_prefill=0, num_decode=2, kv_load=(0.5,)),
+        PlannerState(active_config="x"), _pcfg(),
+    )
+    assert state.active_config == "x"
+
+
+# ------------------------------------------------------- env-knob table
+def _ecfg(**over):
+    return EngineConfig(model=TINY, eos_token_ids=[], **over)
+
+
+@pytest.mark.pre_merge
+def test_env_flag_spellings(monkeypatch):
+    monkeypatch.setenv("DYN_KV_PACKING", "yes")
+    assert _ecfg().kv_packing is True
+    monkeypatch.setenv("DYN_KV_PACKING", "off")
+    assert _ecfg(kv_packing=True).kv_packing is False
+    monkeypatch.setenv("DYN_KV_PACKING", "")
+    assert _ecfg(kv_packing=True).kv_packing is True  # unset = untouched
+    monkeypatch.setenv("DYN_KV_PACKING", "maybe")
+    with pytest.raises(ValueError, match="not a recognized flag spelling"):
+        _ecfg()
+
+
+@pytest.mark.pre_merge
+def test_env_typo_rejected_exempt_name_passes(monkeypatch):
+    monkeypatch.setenv("DYN_KV_PACKNG", "1")  # the silent-no-op bug class
+    with pytest.raises(ValueError, match="unknown engine env knob"):
+        _ecfg()
+    monkeypatch.delenv("DYN_KV_PACKNG")
+    # telemetry.fleet's bandwidth prior lives under the family but is
+    # exempt — it must not trip the engine's table.
+    monkeypatch.setenv("DYN_KV_DEFAULT_BW_BPS", "1e9")
+    _ecfg()  # must not raise
+
+
+@pytest.mark.pre_merge
+def test_env_spec_semantics(monkeypatch):
+    monkeypatch.setenv("DYN_SPEC", "1")
+    assert _ecfg().spec_mode == "ngram"
+    monkeypatch.setenv("DYN_SPEC", "0")
+    assert _ecfg().spec_mode == "off"
+    monkeypatch.setenv("DYN_SPEC", "ngram")
+    assert _ecfg().spec_mode == "ngram"
+    # An explicit spec_mode always wins over the env toggle.
+    monkeypatch.setenv("DYN_SPEC", "0")
+    assert _ecfg(spec_mode="ngram").spec_mode == "ngram"
+    monkeypatch.setenv("DYN_SPEC", "bogus_drafter")
+    with pytest.raises(ValueError, match="neither a flag spelling"):
+        _ecfg()
+
+
+@pytest.mark.pre_merge
+def test_env_proactive_grace(monkeypatch):
+    monkeypatch.setenv("DYN_KV_PROACTIVE", "1")
+    assert _ecfg(proactive_offload_grace_s=-1.0).proactive_offload_grace_s \
+        == 0.0
+    assert _ecfg(proactive_offload_grace_s=0.2).proactive_offload_grace_s \
+        == 0.2
+    monkeypatch.setenv("DYN_KV_PROACTIVE", "0")
+    assert _ecfg(proactive_offload_grace_s=0.2).proactive_offload_grace_s \
+        == -1.0
+
+
+# ------------------------------------------------- bench config pairing
+def _line(metric="decode tok/s", value=100.0, platform="cpu", **extra):
+    return {
+        "metric": metric, "unit": "tok/s", "value": value,
+        "platform": platform, **extra,
+    }
+
+
+@pytest.mark.pre_merge
+def test_bench_compare_same_hash_still_flags_regressions():
+    report = compare_bench(
+        [_line(value=100.0, config_hash="aaa")],
+        [_line(value=50.0, config_hash="aaa")],
+    )
+    assert [f.kind for f in report.findings] == ["regression"]
+
+
+@pytest.mark.pre_merge
+def test_bench_compare_skips_differently_tuned_runs():
+    report = compare_bench(
+        [_line(value=100.0, config_hash="aaa")],
+        [_line(value=50.0, config_hash="bbb")],
+    )
+    assert report.compared == 0 and not report.findings
+    assert any("differently-tuned" in s for s in report.skipped)
+
+
+@pytest.mark.pre_merge
+def test_bench_compare_pairs_by_config_hash_among_same_metric():
+    """An old capture holding the same metric under two configs pairs
+    the new line with ITS config, not whichever parsed last."""
+    old = [
+        _line(value=100.0, config_hash="aaa"),
+        _line(value=50.0, config_hash="bbb"),
+    ]
+    report = compare_bench(old, [_line(value=100.0, config_hash="aaa")])
+    assert report.compared == 1 and report.findings == []
+
+
+@pytest.mark.pre_merge
+def test_bench_compare_legacy_untagged_lines_pair_by_metric():
+    # Checked-in BENCH_r*.json captures predate the stamp: one side (or
+    # both) untagged keeps the metric-name pairing unchanged.
+    report = compare_bench(
+        [_line(value=100.0)], [_line(value=50.0, config_hash="bbb")]
+    )
+    assert report.compared == 1
+    assert [f.kind for f in report.findings] == ["regression"]
+
+
+# ------------------------------------------------------------- evaluate
+@pytest.mark.pre_merge
+def test_evaluate_pinned_workload_overrides_seed_generation():
+    target = _target(n=8)
+    workload = target.workload(123)
+    a = evaluate({}, target, _settings(), seed=123)
+    b = evaluate({}, target, _settings(), seed=999, workload=workload)
+    # Same requests, same sim seed difference only: the pinned list is
+    # what ran (scores computed from it, not from seed-999 generation).
+    c = evaluate({}, target, _settings(), seed=123, workload=workload)
+    assert c == a
+    assert isinstance(b["score"], float)
+
+
+def test_journal_lines_are_canonical_json():
+    result = run_search(_target(), _settings(budget=4))
+    for line in result.journal:
+        blob = json.dumps(line, sort_keys=True)
+        assert json.loads(blob) == line
