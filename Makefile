@@ -31,6 +31,12 @@ TIERING_SEED_SETS := 7,21,1337 3,9,27
 # token-identical to uninterrupted oracles, and a too-short grace must
 # degrade to journal failover with zero lost/duplicated tokens.
 RECLAIM_SEED_SETS := 7,21,1337 5,8,13
+# Durable-KV storage-fault seed sets: the seeded storage chaos family
+# (bit-flip, torn tail, ENOSPC, injected fetch latency, store-dir
+# missing) against the G3 persistent tier (tests/test_kv_persist.py)
+# — corrupt pages must quarantine with token-identical journal
+# re-prefill, and a failing store must degrade to G2-only, never hang.
+STORE_SEED_SETS := 7,21,1337 3,9,27
 
 .PHONY: test pre-merge nightly chaos sim sim-scale flight profile-smoke lint prewarm-smoke bench-compare anatomy-smoke tune-smoke
 
@@ -79,6 +85,10 @@ chaos:
 	for seeds in $(SPEC_SEED_SETS); do \
 		echo "=== spec-on reclaim identity (DYN_SPEC=ngram), CHAOS_SEEDS=$$seeds ==="; \
 		env DYN_SPEC=ngram CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_reclaim.py -q -m chaos; \
+	done; \
+	for seeds in $(STORE_SEED_SETS); do \
+		echo "=== durable-KV storage-fault suite, CHAOS_SEEDS=$$seeds ==="; \
+		env CHAOS_SEEDS=$$seeds $(PYTEST) tests/test_kv_persist.py -q -m chaos; \
 	done
 
 # Seeded simulator regression sets (mirrors `make chaos`): every seed

@@ -77,6 +77,10 @@ CPU_COLDSTART_KW = dict(isl=64, osl=16, concurrency=2)
 # rate point to keep the CI lane seconds-scale.
 CPU_RECLAIM_KW = dict(duration_s=120.0, reclaim_rates=(0.0, 2.0, 6.0))
 
+# Restart sweep CPU fallback: same trim policy as coldstart — tiny
+# shapes, both arms still exercised end to end.
+CPU_RESTART_KW = dict(isl=64, osl=16, concurrency=2)
+
 # Burst policy: warmup rounds (compile + program load) and timed rounds
 # (best-of). The CPU fallback trims both to 1 — XLA:CPU timings are
 # low-variance and a 1B-model burst is minutes, not seconds, there.
@@ -1273,6 +1277,190 @@ def run_coldstart_sweep(
     return [cold, warm, summary]
 
 
+def run_restart_sweep(
+    isl: int = 512, osl: int = 32, concurrency: int = 4
+) -> list[dict]:
+    """Cold-boot vs warm-cache restart TTFT (docs/fault_tolerance.md
+    "Durable KV & corruption containment").
+
+    Three phases against one durable G3 store directory:
+
+    1. **seed** — an engine with the store serves a shared-prefix
+       burst, then a churn burst large enough to evict the parked
+       prefix blocks into the host tier; ``stop()`` drains the host
+       tier through the G3 writer (the crash-consistent demotion
+       path), leaving the prefix on disk.
+    2. **cold** — a fresh engine over an *empty* store serves the
+       identical shared-prefix probe: nothing to adopt, the full
+       prefix re-prefills (the restart-without-durability baseline).
+    3. **warm** — a fresh engine over the seeded store ``boot_scan``s,
+       re-adopts the surviving pages, and serves the same probe: the
+       shared prefix re-attaches from G3 (checksum-verified) and only
+       the per-request suffix prefills.
+
+    Both arms run the same compile warmup first, so the TTFT delta is
+    the shared-prefix prefill cost the durable tier removes — the
+    restart-recovery headline. Lines carry ``prewarmed`` (store, not
+    compile, prewarming here) and the per-arm G3 counters
+    (``kv_prefix_hits_persist``, ``kv_store_adopted``) as proof the
+    warm arm actually restored rather than re-prefilled."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    import jax
+
+    from dynamo_exp_tpu.aot import manifest_for_engine
+    from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+    from dynamo_exp_tpu.models.llama import init_params
+    from dynamo_exp_tpu.protocols.common import BackendInput
+
+    _enable_compile_cache()
+    mcfg = _preset(MODEL)
+    ps = 16
+    prefix_len = (isl // 2) // ps * ps
+    num_pages = concurrency * ((isl + osl) // ps + 2) + 8
+
+    def cfg(store_dir: str) -> EngineConfig:
+        return EngineConfig(
+            model=mcfg,
+            max_decode_slots=concurrency,
+            page_size=ps,
+            # Tight pool: the seed arm's churn burst must evict the
+            # parked prefix into the host tier for stop() to drain.
+            num_pages=num_pages,
+            max_model_len=max(512, ((isl + osl) // 256 + 2) * 256),
+            eos_token_ids=[],
+            kv_dtype=_kv_dtype(),
+            decode_window=8,
+            prefix_sharing=True,
+            host_cache_pages=num_pages * 4,
+            kv_store_dir=store_dir,
+        )
+
+    params = init_params(jax.random.PRNGKey(0), mcfg)
+    jax.block_until_ready(params)
+    rs = np.random.RandomState(0)
+
+    def distinct(n: int) -> list[list[int]]:
+        return [
+            rs.randint(10, mcfg.vocab_size - 10, size=isl).tolist()
+            for _ in range(n)
+        ]
+
+    # One fixed prompt set across all three phases: identical tokens
+    # mean identical chained block hashes, so the warm arm's G3 match
+    # is exactly the seed arm's demoted prefix.
+    warm_prompts = distinct(concurrency)
+
+    def shared_burst() -> list[list[int]]:
+        p = rs.randint(10, mcfg.vocab_size - 10, size=prefix_len).tolist()
+        return [
+            p
+            + rs.randint(
+                10, mcfg.vocab_size - 10, size=isl - prefix_len
+            ).tolist()
+            for _ in range(concurrency)
+        ]
+
+    # A second, never-stored shared prefix for warmup: the probe's
+    # suffix-length prefill bucket must compile during warmup in BOTH
+    # arms, or the warm arm's G3-shortened prefill pays a variant
+    # compile the cold arm's full-prompt path never hits.
+    probe_prompts, suffix_warm_prompts = shared_burst(), shared_burst()
+    churn_prompts = distinct(2 * concurrency)
+
+    async def run_one(engine, prompt):
+        b = BackendInput(token_ids=prompt)
+        b.stop_conditions.max_tokens = osl
+        b.stop_conditions.ignore_eos = True
+        t0 = time.perf_counter()
+        stream = await engine.generate(b.to_dict())
+        ttft = None
+        async for item in stream:
+            if item.get("token_ids") and ttft is None:
+                ttft = time.perf_counter() - t0
+        return ttft
+
+    async def burst(engine, prompts):
+        return await asyncio.gather(*[run_one(engine, p) for p in prompts])
+
+    seeded_store = tempfile.mkdtemp(prefix="dynamo_restart_g3_")
+    empty_store = tempfile.mkdtemp(prefix="dynamo_restart_empty_")
+
+    # Phase 1: seed the store, then churn the prefix off-device and
+    # drain it to disk through the stop() path.
+    engine = TPUEngine(cfg(seeded_store), params=params, seed=0)
+    manifest = manifest_for_engine(engine)
+    engine.start()
+    asyncio.run(burst(engine, warm_prompts))
+    asyncio.run(burst(engine, probe_prompts))
+    for i in range(0, len(churn_prompts), concurrency):
+        asyncio.run(burst(engine, churn_prompts[i : i + concurrency]))
+    engine.stop()
+    seeded_pages = engine.g3_store.resident if engine.g3_store else 0
+
+    def arm(store_dir: str, prewarmed: bool) -> dict:
+        engine = TPUEngine(cfg(store_dir), params=params, seed=0)
+        adopted = engine.g3_store.adopted if engine.g3_store else 0
+        engine.start()
+        asyncio.run(burst(engine, warm_prompts))  # full-prompt warmup
+        asyncio.run(burst(engine, suffix_warm_prompts))  # suffix bucket
+        ttfts = sorted(
+            t
+            for t in asyncio.run(burst(engine, probe_prompts))
+            if t is not None
+        )
+        m = engine.metrics()
+        point = {
+            "metric": (
+                f"restart_{MODEL}_isl{isl}_osl{osl}_c{concurrency}_"
+                f"{'warm' if prewarmed else 'cold'}"
+            ),
+            "value": round(ttfts[len(ttfts) // 2], 3) if ttfts else None,
+            "unit": "s probe-burst ttft p50",
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 3)
+            if ttfts
+            else None,
+            "ttft_max_s": round(ttfts[-1], 3) if ttfts else None,
+            "prefix_tokens": prefix_len,
+            "prewarmed": prewarmed,
+            "manifest_hash": manifest.hash(),
+            "kv_store_adopted": adopted,
+            "kv_prefix_hits_persist": m.get("kv_prefix_hits_persist", 0),
+            "kv_store_checksum_failures": m.get(
+                "kv_store_checksum_failures", 0
+            ),
+            "dispatch": _dispatch_stats(engine),
+            "anatomy": _anatomy_stats(engine),
+        }
+        engine.stop()
+        return point
+
+    cold = arm(empty_store, False)
+    warm = arm(seeded_store, True)
+
+    def ratio(a, b):
+        return round(a / b, 2) if a and b else None
+
+    summary = {
+        "metric": f"restart_{MODEL}_isl{isl}_osl{osl}_c{concurrency}"
+        "_speedup",
+        "value": ratio(cold["ttft_p50_s"], warm["ttft_p50_s"]),
+        "unit": "x cold/warm probe ttft p50",
+        "seeded_store_pages": seeded_pages,
+        "warm_adopted_pages": warm["kv_store_adopted"],
+        "warm_persist_hits": warm["kv_prefix_hits_persist"],
+        "cold_ttft_p50_s": cold["ttft_p50_s"],
+        "warm_ttft_p50_s": warm["ttft_p50_s"],
+        "prewarmed": True,
+        "manifest_hash": manifest.hash(),
+    }
+    shutil.rmtree(empty_store, ignore_errors=True)
+    shutil.rmtree(seeded_store, ignore_errors=True)
+    return [cold, warm, summary]
+
+
 def run_reclaim_sweep(
     seed: int = 11,
     spot_fraction: float = 0.5,
@@ -1481,6 +1669,13 @@ def main() -> None:
         "against one persistent compile cache (docs/aot.md)",
     )
     ap.add_argument(
+        "--restart-sweep",
+        action="store_true",
+        help="cold-boot vs durable-G3 warm-cache restart: shared-prefix "
+        "probe TTFT per arm against one seeded store directory, with "
+        "adopted-page / persist-hit proof (docs/fault_tolerance.md)",
+    )
+    ap.add_argument(
         "--reclaim-sweep",
         action="store_true",
         help="spot-reclamation economics (sim-driven): goodput, "
@@ -1546,6 +1741,10 @@ def main() -> None:
         return
     if args.coldstart_sweep:
         for point in run_coldstart_sweep(**(CPU_COLDSTART_KW if cpu else {})):
+            emit(point)
+        return
+    if args.restart_sweep:
+        for point in run_restart_sweep(**(CPU_RESTART_KW if cpu else {})):
             emit(point)
         return
     if args.sweep:

@@ -32,6 +32,11 @@ HOT_PATH_ZONES: tuple[Zone, ...] = (
     # register_full_page on the loop thread — pure host bookkeeping,
     # and it must stay that way.
     Zone("dynamo_exp_tpu/kv/prefix.py"),
+    # The G3 persistent store's match/fetch run inside admission and
+    # the prefetch planner on the loop thread (file I/O is host work;
+    # no device value may reach it, and no blocking transfer may hide
+    # in it).
+    Zone("dynamo_exp_tpu/kv/persistent.py"),
     # Predictive KV tiering (docs/engine_perf.md "Predictive KV
     # tiering"): footprint forecasts, packing selection, and swap
     # planning all run inside the admission/pressure paths on the loop
@@ -58,6 +63,13 @@ DETERMINISM_ZONES: tuple[Zone, ...] = (
     Zone("dynamo_exp_tpu/sim/"),
     Zone("dynamo_exp_tpu/spec/"),
     Zone("dynamo_exp_tpu/runtime/transports/chaos.py"),
+    # The G3 persistent store (docs/fault_tolerance.md "Durable KV"):
+    # eviction order, boot-scan adoption order, and chaos fault
+    # injection must all be seed/insertion-deterministic — the
+    # restart-identity and corruption-containment suites compare token
+    # streams bit-for-bit across runs. The only sanctioned sleep is the
+    # injected-latency fault (time.sleep is not a clock read).
+    Zone("dynamo_exp_tpu/kv/persistent.py"),
     Zone("dynamo_exp_tpu/telemetry/flight.py", include=("FlightRecorder",)),
     # The AOT compile lattice (docs/aot.md): the manifest hash IS the
     # cache-invalidation key, so enumeration and hashing must be free
@@ -157,6 +169,8 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 "_last_gauge_pub",
                 "_last_reap",
                 "_pub_prefix_hits",  # gauge-publish counter snapshots
+                "_pub_store_checksum_failures",  # G3 counter snapshots
+                "_pub_store_quarantined",
                 # KV conservation auditor (docs/observability.md "KV
                 # conservation auditor"): the in-loop check's episode
                 # state and violation counter, plus the open lease-span
@@ -206,6 +220,7 @@ OWNERSHIP_MANIFESTS: tuple[ThreadManifest, ...] = (
                 # Internally synchronized (lock / GIL-relying, see the
                 # lock manifests and DispatchProfiler docstring).
                 "host_pool",
+                "g3_store",  # PersistentKvStore, internally locked
                 "flight",
                 "profiler",
                 "anatomy_ring",  # worst-N exemplars, internally locked
@@ -236,6 +251,17 @@ LOCK_MANIFESTS: tuple[LockManifest, ...] = (
         guarded=frozenset(
             {"_k", "_v", "_free", "_by_hash", "stores", "hits", "evictions"}
         ),
+    ),
+    LockManifest(
+        # The G3 persistent store's index + ledger counters: written by
+        # the copy thread (demotions) and the engine loop (admission
+        # promotes, stop drain), read by both. File I/O deliberately
+        # runs OUTSIDE the lock (content addressing makes same-hash
+        # racers benign); only the index/counter state is guarded.
+        path="dynamo_exp_tpu/kv/persistent.py",
+        cls="PersistentKvStore",
+        lock="_lock",
+        guarded=frozenset({"_by_hash", "_quarantined"}),
     ),
     LockManifest(
         path="dynamo_exp_tpu/telemetry/flight.py",

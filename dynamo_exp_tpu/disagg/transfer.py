@@ -50,29 +50,74 @@ def _dtype_from_name(name: str) -> np.dtype:
     return np.dtype(name)
 
 
+# Inbound KV pages that failed their per-page wire checksum — every
+# restore path that decodes pages off the wire (disagg inject AND the
+# reclaim migration sink, both through KvPageReceiver._handle) verifies
+# before the bytes can become matchable KV; a mismatch fails the
+# transfer and the request degrades to local/journal prefill
+# (token-identical). Mirrored as engine.metrics()
+# ``kv_wire_checksum_failures`` and dynamo_kv_checksum_failures_total
+# {path="wire"}.
+_WIRE_CHECKSUM_FAILURES = 0
+
+
+def wire_checksum_failures() -> int:
+    return _WIRE_CHECKSUM_FAILURES
+
+
 def encode_pages(pages: list[tuple[np.ndarray, np.ndarray]]) -> tuple[dict, bytes]:
-    """Pack [(k_page, v_page), ...] into (header, payload)."""
+    """Pack [(k_page, v_page), ...] into (header, payload). The header
+    carries a per-page CRC32 over each page's K+V bytes (``sums``) so
+    the receive side verifies content end-to-end — the framing codec's
+    transport is reliable, but the page bytes traverse two host copies
+    and (in chaos runs) seeded corruption on either side."""
+    import zlib
+
     if not pages:
-        return {"n_pages": 0, "shape": [], "dtype": "float32"}, b""
+        return {"n_pages": 0, "shape": [], "dtype": "float32", "sums": []}, b""
     shape = list(pages[0][0].shape)
     dtype = pages[0][0].dtype
     buf = bytearray()
+    sums: list[int] = []
     for k, v in pages:
-        buf += np.ascontiguousarray(k).tobytes()
-        buf += np.ascontiguousarray(v).tobytes()
-    return {"n_pages": len(pages), "shape": shape, "dtype": str(dtype)}, bytes(buf)
+        kb = np.ascontiguousarray(k).tobytes()
+        vb = np.ascontiguousarray(v).tobytes()
+        sums.append(zlib.crc32(vb, zlib.crc32(kb)))
+        buf += kb
+        buf += vb
+    return {
+        "n_pages": len(pages), "shape": shape, "dtype": str(dtype),
+        "sums": sums,
+    }, bytes(buf)
 
 
 def decode_pages(header: dict, payload: bytes) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Unpack pages, verifying each against the header's per-page CRC
+    when present (older senders omit ``sums``; their frames decode
+    unverified for compatibility). A mismatch raises ``ValueError`` —
+    the receiver fails the transfer future and the restore path falls
+    back to re-prefill rather than ever serving the corrupt page."""
+    import zlib
+
+    global _WIRE_CHECKSUM_FAILURES
     n = header["n_pages"]
     if n == 0:
         return []
     shape = tuple(header["shape"])
     dtype = _dtype_from_name(header["dtype"])
     per = int(np.prod(shape)) * dtype.itemsize
+    sums = header.get("sums")
     pages = []
     for i in range(n):
         off = i * 2 * per
+        if sums is not None:
+            crc = zlib.crc32(payload[off : off + 2 * per])
+            if crc != sums[i]:
+                _WIRE_CHECKSUM_FAILURES += 1
+                get_telemetry().kv_checksum_failures.labels("wire").inc()
+                raise ValueError(
+                    f"KV wire checksum mismatch on page {i}/{n}"
+                )
         k = np.frombuffer(payload, dtype, count=int(np.prod(shape)), offset=off)
         v = np.frombuffer(payload, dtype, count=int(np.prod(shape)), offset=off + per)
         pages.append((k.reshape(shape), v.reshape(shape)))

@@ -26,12 +26,17 @@ _FALSY = frozenset({"0", "false", "no", "off"})
 #   "spec"  — DYN_SPEC: truthy -> the "ngram" drafter, falsy -> stay
 #             off, any other value must be a registered drafter name
 #             (the PR 7 falsy-spelling bug class, now structural: every
-#             spelling is validated at construction).
+#             spelling is validated at construction);
+#   "path"  — DYN_KV_STORE: a directory path sets the attr verbatim
+#             (arming the G3 persistent tier suite-wide), a falsy
+#             spelling clears it; truthy spellings raise (the knob
+#             needs an actual path, not "1").
 _ENV_KNOBS: tuple[tuple[str, str, str], ...] = (
     ("DYN_SPEC", "spec_mode", "spec"),
     ("DYN_KV_PACKING", "kv_packing", "flag"),
     ("DYN_KV_PREFETCH", "kv_prefetch", "flag"),
     ("DYN_KV_PROACTIVE", "proactive_offload_grace_s", "grace"),
+    ("DYN_KV_STORE", "kv_store_dir", "path"),
 )
 # Env-name families this table owns: any OTHER name under these
 # prefixes is a typo (DYN_KV_PACKNG=1 must fail loudly, not silently
@@ -243,6 +248,19 @@ class EngineConfig:
     # preempt_stall_grace_s to fire first (preemption stays the
     # fallback when swapping can't free enough).
     proactive_offload_grace_s: float = 0.0
+    # ---- G3 persistent KV tier (docs/fault_tolerance.md "Durable KV &
+    # corruption containment"). Empty disables. Pages LRU-demoted out of
+    # the G2 host pool land here as checksummed, crash-recoverable files
+    # keyed by the same chained block hashes; a restarted process
+    # boot-scans the directory and re-attaches surviving prefixes.
+    # Requires a host tier (host_cache_pages > 0) — demotion rides its
+    # eviction path. DYN_KV_STORE=<dir> arms it suite-wide.
+    kv_store_dir: str = ""
+    # Store capacity in pages; LRU-evicted beyond this. <= 0 with a
+    # kv_store_dir set is rejected at construction.
+    kv_store_pages: int = 4096
+    # Seeded StorageChaos schedule (tests only; never set in prod).
+    kv_store_chaos: object = None
 
     def __post_init__(self):
         if not self.prefill_buckets:
@@ -251,6 +269,11 @@ class EngineConfig:
         if self.kv_dtype not in ("bfloat16", "float32"):
             raise ValueError(f"unsupported kv_dtype: {self.kv_dtype!r}")
         self._apply_env_knobs()
+        if self.kv_store_dir and self.kv_store_pages <= 0:
+            raise ValueError(
+                f"kv_store_dir={self.kv_store_dir!r} needs "
+                f"kv_store_pages > 0 (got {self.kv_store_pages})"
+            )
         if self.spec_max_draft < self.spec_min_draft or self.spec_min_draft < 1:
             raise ValueError(
                 f"bad spec draft bounds [{self.spec_min_draft}, "
@@ -273,6 +296,17 @@ class EngineConfig:
                 continue
             if kind == "flag":
                 setattr(self, attr, _parse_env_flag(name, raw))
+            elif kind == "path":
+                low = raw.lower()
+                if low in _FALSY:
+                    setattr(self, attr, "")
+                elif low in _TRUTHY:
+                    raise ValueError(
+                        f"{name}={raw!r} must be a directory path (or a "
+                        f"falsy spelling to disable), not a bare flag"
+                    )
+                else:
+                    setattr(self, attr, raw)
             elif kind == "grace":
                 if _parse_env_flag(name, raw):
                     self.proactive_offload_grace_s = max(

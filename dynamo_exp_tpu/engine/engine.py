@@ -89,6 +89,7 @@ from ..telemetry.fingerprint import (
     WorkloadDriftWatch,
     load_fingerprint,
 )
+from ..kv.persistent import PersistentKvStore
 from ..telemetry.flight import (
     FlightRecorder,
     Watchdog,
@@ -283,6 +284,37 @@ class TPUEngine(AsyncEngine):
                 # host sync instead of one per page.
                 self._pending_offloads.append((pid, seq_hash))
 
+        # G3 persistent tier (docs/fault_tolerance.md "Durable KV &
+        # corruption containment"): boot-scan the store (torn tails
+        # quarantined, survivors adopted as matchable entries — the
+        # restart warm cache), then wire G2's LRU demotions into it. A
+        # degraded store (missing dir, ENOSPC) logs and the engine runs
+        # G2-only — never a stall, never a crash.
+        self.g3_store: PersistentKvStore | None = None
+        if cfg.kv_store_dir:
+            if self.host_pool is None:
+                log.warning(
+                    "kv_store_dir=%r ignored: the G3 tier rides the G2 "
+                    "host pool's eviction path (set host_cache_pages > 0)",
+                    cfg.kv_store_dir,
+                )
+            else:
+                self.g3_store = PersistentKvStore(
+                    cfg.kv_store_dir,
+                    cfg.kv_store_pages,
+                    page_shape,
+                    cfg.kv_dtype_jnp,
+                    chaos=cfg.kv_store_chaos,
+                )
+                adopted = self.g3_store.boot_scan()
+                if adopted or self.g3_store.torn_pages:
+                    log.info(
+                        "G3 store %s: adopted %d page(s), quarantined %d "
+                        "torn", cfg.kv_store_dir, adopted,
+                        self.g3_store.torn_pages,
+                    )
+                self.host_pool.on_demote = self.g3_store.store
+
         self.kv = KvPageManager(
             cfg.num_pages,
             cfg.page_size,
@@ -290,6 +322,7 @@ class TPUEngine(AsyncEngine):
             host_pool=self.host_pool,
             on_evict=on_evict,
             sharing=cfg.prefix_sharing,
+            g3_store=self.g3_store,
         )
         # Observability (docs/observability.md): per-dispatch profiler
         # (host gap vs in-flight, compile attribution — pure timestamps
@@ -428,7 +461,13 @@ class TPUEngine(AsyncEngine):
         # prefix-hit mirror advances by delta at gauge-publish time (the
         # page manager itself is telemetry-free; COW has its own event-
         # site counter in _resolve_shared_tail).
-        self._pub_prefix_hits = {"shared": 0, "restore": 0, "miss": 0}
+        self._pub_prefix_hits = {
+            "shared": 0, "restore": 0, "persist": 0, "miss": 0
+        }
+        # Published-so-far G3 corruption counters (delta mirroring, like
+        # _pub_prefix_hits — the store's own counters are authoritative).
+        self._pub_store_checksum_failures = 0
+        self._pub_store_quarantined = 0
         # KV conservation auditor (docs/observability.md "KV
         # conservation auditor"): the loop runs the page manager's O(1)
         # counter-delta check every iteration; a *new* violation set
@@ -841,8 +880,9 @@ class TPUEngine(AsyncEngine):
             self._pending_offloads.clear()  # dynlint: thread-ownership(loop thread joined before teardown flush)
         if self.host_pool is not None and self.copy_stream is None:
             # stop() tears the copy stream down; a restarted engine needs
-            # a live one before the first eviction fires on_evict.
-            self.copy_stream = CopyStream(self.host_pool)
+            # a live one before the first eviction fires on_evict. The G3
+            # store rides along so prefetch fetches fall through G2→G3.
+            self.copy_stream = CopyStream(self.host_pool, store=self.g3_store)
         self._running = True
         self._thread = threading.Thread(
             target=self._loop, name="tpu-engine-loop", daemon=True
@@ -894,10 +934,11 @@ class TPUEngine(AsyncEngine):
             # teardown; the strings embed counter values, so kind-level
             # comparison is the stable one).
             seen = set(self._ledger_last)
+            final_all = self.kv.ledger_check()
+            if self.g3_store is not None:
+                final_all = final_all + self.g3_store.ledger_check()
             final = [
-                v
-                for v in self.kv.ledger_check()
-                if v.split(":", 1)[0] not in seen
+                v for v in final_all if v.split(":", 1)[0] not in seen
             ]
             if final:
                 self.kv_ledger_violations += len(final)  # dynlint: thread-ownership(loop thread joined before teardown flush)
@@ -920,6 +961,15 @@ class TPUEngine(AsyncEngine):
             self.copy_stream.drain()
             self.copy_stream.stop()
             self.copy_stream = None
+        if self.g3_store is not None:
+            # Graceful-shutdown G2→G3 drain (after the copy stream has
+            # committed every pending offload into the host pool, and
+            # strictly after the wedged-loop early return above): demote
+            # the whole warm G2 set so the sealed manifest covers it —
+            # the next boot's cache is as warm as this process was.
+            for h, k_page, v_page in self.host_pool.snapshot():
+                self.g3_store.store(h, k_page, v_page)
+            self.g3_store.seal()
         while not self._prefetch_done_q.empty():
             try:
                 job, _fetched = self._prefetch_done_q.get_nowait()
@@ -1786,6 +1836,21 @@ class TPUEngine(AsyncEngine):
                 # G2 tier occupancy (docs/engine_perf.md "Predictive KV
                 # tiering"): host-tier pressure is fleet-visible.
                 tel.kv_host_pages.set(self.host_pool.resident)
+            if self.g3_store is not None:
+                # G3 tier occupancy + corruption counters (by delta —
+                # the store's in-object counters are authoritative).
+                tel.kv_store_pages.set(self.g3_store.resident)
+                delta = (
+                    self.g3_store.checksum_failures
+                    - self._pub_store_checksum_failures
+                )
+                if delta:
+                    tel.kv_checksum_failures.labels("store").inc(delta)
+                    self._pub_store_checksum_failures += delta
+                delta = self.g3_store.quarantined - self._pub_store_quarantined
+                if delta:
+                    tel.kv_quarantined.inc(delta)
+                    self._pub_store_quarantined += delta
             # Prefix-hit counters advance by delta (the page manager is
             # telemetry-free; its in-object counters are authoritative).
             for kind, total in self.kv.prefix_hits.items():
@@ -1862,6 +1927,11 @@ class TPUEngine(AsyncEngine):
         the first violation of an episode dumps a flight snapshot
         carrying the full named audit."""
         violations = self.kv.ledger_check()
+        if self.g3_store is not None:
+            # G3 pages join the ledger at their demote/promote/
+            # quarantine transitions — same O(1) counter-arithmetic
+            # style, one extra list concat per loop iteration.
+            violations = violations + self.g3_store.ledger_check()
         sig = self._violation_kinds(violations)
         if sig == self._ledger_last:
             return
@@ -1897,7 +1967,16 @@ class TPUEngine(AsyncEngine):
         for s in self.sched.waiting:
             if getattr(s, "page_ids", None):
                 holders[f"seq:{s.request_id}"] = list(s.page_ids)
-        return self.kv.audit(holders)
+        report = self.kv.audit(holders)
+        if self.g3_store is not None:
+            # G3 tier joins the audit: its own conservation ledger
+            # (resident == adopted + stores - evictions - quarantined)
+            # rendered next to the G1 page ledger by `llmctl audit`.
+            g3 = self.g3_store.ledger()
+            report["g3"] = g3
+            if g3["violations"]:
+                report["ok"] = False
+        return report
 
     def _compute_build_info(self) -> dict:
         """Config-skew fingerprint for fleet scrapes: the AOT lattice
@@ -2320,7 +2399,14 @@ class TPUEngine(AsyncEngine):
             rest = hashes[len(matched):]
             if not rest:
                 continue
-            g2 = self.host_pool.match_chain(rest)[:budget]
+            g2 = self.host_pool.match_chain(rest)
+            if self.g3_store is not None and len(g2) < len(rest):
+                # Extend candidacy into the G3 store: the copy stream's
+                # fetch falls through G2→G3 per page (checksum-verified
+                # there), so a store-resident tail restores on the same
+                # overlapped path — G3→G2→G1 ahead of admission.
+                g2 = g2 + self.g3_store.match_chain(rest[len(g2):])
+            g2 = g2[:budget]
             if not g2:
                 continue
             pids: list[int] = []
@@ -3744,6 +3830,7 @@ class TPUEngine(AsyncEngine):
         m["kv_peak_pages"] = self.kv.peak_active_pages
         m["kv_prefix_hits_shared"] = self.kv.prefix_hits["shared"]
         m["kv_prefix_hits_restore"] = self.kv.prefix_hits["restore"]
+        m["kv_prefix_hits_persist"] = self.kv.prefix_hits["persist"]
         m["kv_prefix_hits_miss"] = self.kv.prefix_hits["miss"]
         # The ONE ragged variant cache (docs/engine_perf.md "One
         # ragged dispatch") replaces the old per-family mirrors.
@@ -3779,6 +3866,29 @@ class TPUEngine(AsyncEngine):
             m["host_cache_resident"] = self.host_pool.resident
             m["host_cache_hits"] = self.host_pool.hits
             m["host_cache_stores"] = self.host_pool.stores
+        if self.g3_store is not None:
+            # G3 persistent tier (docs/fault_tolerance.md "Durable KV &
+            # corruption containment"): occupancy, demote/promote
+            # traffic, crash-recovery adoption, and the corruption-
+            # containment counters the chaos suites assert on.
+            g3 = self.g3_store
+            m["kv_store_resident"] = g3.resident
+            m["kv_store_adopted"] = g3.adopted
+            m["kv_store_demotes"] = g3.stores
+            m["kv_store_promotes"] = g3.hits
+            m["kv_store_evictions"] = g3.evictions
+            m["kv_store_quarantined"] = g3.quarantined
+            m["kv_store_torn"] = g3.torn_pages
+            m["kv_store_checksum_failures"] = g3.checksum_failures
+            m["kv_store_errors"] = g3.store_errors
+            m["kv_store_degraded"] = int(g3.degraded)
+        # Wire-checksum failures on inbound KV transfers (disagg inject
+        # and the reclaim migration sink both decode through the same
+        # verifier; a mismatch fails the transfer and the request falls
+        # back to local/journal prefill).
+        from ..disagg.transfer import wire_checksum_failures
+
+        m["kv_wire_checksum_failures"] = wire_checksum_failures()
         # Predictive KV tiering (docs/engine_perf.md "Predictive KV
         # tiering"): G2→G1 prefetch outcomes and proactive-offload
         # (swap) traffic — bench.py's offload-pressure axis reads these.

@@ -142,6 +142,7 @@ class KvPageManager:
         on_evict: Callable[[int, int], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
         sharing: bool = True,
+        g3_store=None,
     ):
         self.num_pages = num_pages
         self.page_size = page_size
@@ -157,6 +158,12 @@ class KvPageManager:
         # matched back in from ``host_pool`` on later prompts.
         self.host_pool = host_pool
         self.on_evict = on_evict
+        # G3 tier (docs/fault_tolerance.md "Durable KV"): the persistent
+        # checksummed page store. Admission extends a G1+G2 match into
+        # it; each fetched page is checksum-verified by the store and
+        # promoted through the host pool (a corrupt page quarantines
+        # there and just shortens the restored prefix).
+        self.g3_store = g3_store
         self._records: dict[int, PageRecord] = {
             i: PageRecord(i) for i in range(num_pages)
         }
@@ -183,7 +190,9 @@ class KvPageManager:
         # hit breakdown at admission, copy-on-write copies, and the
         # high-water mark of resident pages (bench.py --prefix-sweep
         # reads pages-per-request off the peak).
-        self.prefix_hits = {"shared": 0, "restore": 0, "miss": 0}
+        # "persist" = pages restored from the G3 store at admission —
+        # the restart re-attachment proof the identity tests read.
+        self.prefix_hits = {"shared": 0, "restore": 0, "persist": 0, "miss": 0}
         self.cow_copies = 0
         self.peak_active_pages = 0
         # Incrementally tracked (refcount 1→2 / 2→1 crossings), so the
@@ -240,6 +249,9 @@ class KvPageManager:
             # tiering"): host-resident pages, so fleet views see
             # host-tier pressure (mirrored as dynamo_kv_host_pages).
             "kv_host_pages": self.host_pool.resident if self.host_pool else 0,
+            # G3 tier occupancy (docs/fault_tolerance.md "Durable KV"):
+            # store-resident pages (mirrored as dynamo_kv_store_pages).
+            "kv_store_pages": self.g3_store.resident if self.g3_store else 0,
         }
 
     def _note_active(self) -> None:
@@ -308,6 +320,14 @@ class KvPageManager:
         g2_hashes: list[int] = []
         if self.sharing and self.host_pool is not None:
             g2_hashes = self.host_pool.match_chain(hashes[len(matched_pages) :])
+        # Extend further into the G3 persistent store (membership only;
+        # bytes are checksum-verified at fetch below) — the path a
+        # returning conversation re-attaches through after a restart.
+        g3_hashes: list[int] = []
+        if self.sharing and self.g3_store is not None:
+            g3_hashes = self.g3_store.match_chain(
+                hashes[len(matched_pages) + len(g2_hashes) :]
+            )
         # Shared partial tail: the prompt ends inside a block some other
         # sequence registered — attach that page read-shared; the owner
         # COWs it before its first divergent (decode) write.
@@ -317,6 +337,7 @@ class KvPageManager:
             self.sharing
             and tail_tokens
             and not g2_hashes
+            and not g3_hashes
             and len(matched_pages) == n_tokens // ps
         ):
             parent = matched_hashes[-1] if matched_hashes else None
@@ -344,11 +365,28 @@ class KvPageManager:
             if data is None:
                 break
             host_pages.append((h, data[0], data[1]))
+        # G3 fetches only extend an UNBROKEN chain (a mid-chain G2
+        # eviction makes the G3 tail unmatchable). Each fetch is
+        # checksum-verified inside the store — a corrupt page
+        # quarantines, returns None, and the restored prefix shortens
+        # (the block re-prefills from the prompt, token-identically).
+        # Verified bytes promote through the host pool so sibling
+        # admissions hit RAM next time.
+        persist_pages: list[tuple[int, "np.ndarray", "np.ndarray"]] = []
+        if len(host_pages) == len(g2_hashes):
+            for h in g3_hashes:
+                data = self.g3_store.fetch(h)
+                if data is None:
+                    break
+                persist_pages.append((h, data[0], data[1]))
+                if self.host_pool is not None:
+                    self.host_pool.store(h, data[0], data[1])
+        restore_pages = host_pages + persist_pages
         for pid in attach:  # commit the reuse
             self._ref_page(pid)
         fresh = [self._take_free() for _ in range(need_fresh)]
         uploads = [
-            (fresh[j], h, k, v) for j, (h, k, v) in enumerate(host_pages)
+            (fresh[j], h, k, v) for j, (h, k, v) in enumerate(restore_pages)
         ]
         # Register this sequence's own full prompt pages NOW (pending
         # fill): a same-prefix request admitted next can share them.
@@ -356,7 +394,7 @@ class KvPageManager:
         # walk it already does (_register_uploads); pages past the
         # uploads are this request's to compute.
         if self.sharing:
-            for j in range(len(host_pages), need_fresh):
+            for j in range(len(restore_pages), need_fresh):
                 block_idx = len(matched_pages) + j
                 if (block_idx + 1) * ps > n_tokens:
                     break  # partial tail block: never registered early
@@ -378,15 +416,16 @@ class KvPageManager:
                             token_blocks=[block],
                         )
                     )
-        self.hits += len(attach) + len(host_pages)
-        self.misses += need_fresh - len(host_pages)
+        self.hits += len(attach) + len(restore_pages)
+        self.misses += need_fresh - len(restore_pages)
         if self.host_pool is not None:
             self.offload_hits += len(host_pages)
-            self.offload_misses += need_fresh - len(host_pages)
+            self.offload_misses += need_fresh - len(restore_pages)
         self.prefix_hits["shared"] += len(attach)
         self.prefix_hits["restore"] += len(host_pages)
-        self.prefix_hits["miss"] += need_fresh - len(host_pages)
-        cached_pages = len(matched_pages) + len(host_pages)
+        self.prefix_hits["persist"] += len(persist_pages)
+        self.prefix_hits["miss"] += need_fresh - len(restore_pages)
+        cached_pages = len(matched_pages) + len(restore_pages)
         cached = cached_pages * ps + (shared_tail[1] if shared_tail else 0)
         cached = min(cached, n_tokens - 1)
         wait_fill = [

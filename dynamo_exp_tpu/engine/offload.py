@@ -42,7 +42,13 @@ class HostKvPool:
     the engine loop thread.
     """
 
-    def __init__(self, num_pages: int, page_shape: tuple[int, ...], dtype):
+    def __init__(
+        self,
+        num_pages: int,
+        page_shape: tuple[int, ...],
+        dtype,
+        on_demote=None,
+    ):
         self.num_pages = num_pages
         self._k = np.zeros((num_pages,) + page_shape, dtype)
         self._v = np.zeros((num_pages,) + page_shape, dtype)
@@ -50,6 +56,13 @@ class HostKvPool:
         # seq_hash -> host slot; OrderedDict doubles as the LRU (oldest first).
         self._by_hash: OrderedDict[int, int] = OrderedDict()
         self._lock = threading.Lock()
+        # ``on_demote(seq_hash, k_copy, v_copy)``: called with a COPY of
+        # each LRU-evicted page's bytes, outside the pool lock — the
+        # G2→G3 demotion hook (docs/fault_tolerance.md "Durable KV").
+        # Runs on whichever thread triggered the eviction (copy thread
+        # for offloads, loop thread for admission promotes); the G3
+        # writer never fsyncs per page, so neither stalls.
+        self.on_demote = on_demote
         # Metrics.
         self.stores = 0
         self.hits = 0
@@ -65,21 +78,35 @@ class HostKvPool:
             return len(self._by_hash)
 
     def store(self, seq_hash: int, k_page: np.ndarray, v_page: np.ndarray) -> None:
-        """Insert one page; evicts the LRU page when full. Idempotent per
-        hash (a page already resident is refreshed, not duplicated)."""
+        """Insert one page; evicts the LRU page when full (the victim's
+        bytes are copied out and handed to :attr:`on_demote` — cold
+        G2 → G3 — before the slot is overwritten). Idempotent per hash
+        (a page already resident is refreshed, not duplicated)."""
+        demoted = None
         with self._lock:
             slot = self._by_hash.get(seq_hash)
             if slot is None:
                 if self._free:
                     slot = self._free.pop()
                 else:
-                    _, slot = self._by_hash.popitem(last=False)
+                    h_old, slot = self._by_hash.popitem(last=False)
                     self.evictions += 1
+                    if self.on_demote is not None:
+                        # Copy before the overwrite below; callback fires
+                        # outside the lock (it does file I/O).
+                        demoted = (
+                            h_old, self._k[slot].copy(), self._v[slot].copy()
+                        )
                 self._by_hash[seq_hash] = slot
             self._by_hash.move_to_end(seq_hash)
             self._k[slot] = k_page
             self._v[slot] = v_page
             self.stores += 1
+        if demoted is not None:
+            try:
+                self.on_demote(*demoted)
+            except Exception:  # a broken G3 writer must not break G2
+                log.exception("G2->G3 demotion callback failed")
 
     def fetch(self, seq_hash: int) -> tuple[np.ndarray, np.ndarray] | None:
         """Copy one page out (the copy pins the content against a
@@ -103,6 +130,17 @@ class HostKvPool:
                 out.append(h)
         return out
 
+    def snapshot(self) -> list[tuple[int, np.ndarray, np.ndarray]]:
+        """Copy every resident page out, LRU-oldest first, without
+        touching recency or hit counters — the graceful-shutdown G2→G3
+        drain (``TPUEngine.stop``) walks this so the sealed manifest
+        covers the whole warm set."""
+        with self._lock:
+            return [
+                (h, self._k[slot].copy(), self._v[slot].copy())
+                for h, slot in self._by_hash.items()
+            ]
+
 
 class CopyStream:
     """Background device↔host copy stream.
@@ -124,8 +162,13 @@ class CopyStream:
     prefetches exactly like offloads.
     """
 
-    def __init__(self, pool: HostKvPool, max_inflight: int = 256):
+    def __init__(self, pool: HostKvPool, max_inflight: int = 256, store=None):
         self.pool = pool
+        # Optional G3 PersistentKvStore: fetches that miss G2 fall
+        # through to it (checksum-verified there) and promote the bytes
+        # back into the host pool, so a G3→G1 restore overlaps compute
+        # exactly like a G2→G1 one.
+        self.store = store
         # Bounded: each offload entry pins a gathered K/V device-array
         # pair, so a burst of evictions outpacing the blocking host
         # transfers must shed load (the tier is a cache — dropping an
@@ -219,6 +262,14 @@ class CopyStream:
                     fetched = []
                     for h in seq_hashes:
                         data = self.pool.fetch(h)
+                        if data is None and self.store is not None:
+                            # G3 fallback: checksum-verified fetch (a
+                            # corrupt page quarantines there and stays
+                            # None — the chain just shortens). Promote
+                            # the survivor into G2 so siblings hit RAM.
+                            data = self.store.fetch(h)
+                            if data is not None:
+                                self.pool.store(h, data[0], data[1])
                         if data is None:
                             break  # chain broken: later pages unmatchable
                         fetched.append((h, data[0], data[1]))

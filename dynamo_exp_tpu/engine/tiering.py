@@ -100,7 +100,10 @@ class SeqForecast:
 
     total_pages: int  # lifetime footprint (prompt + budget), in pages
     resident_pages: int  # G1 radix-matched prefix (no fresh allocation)
-    host_pages: int  # G2-resident beyond the G1 match (fresh page, no recompute)
+    # G2/G3-resident beyond the G1 match (fresh page, no recompute):
+    # restorable tiers count the same for packing — either way the
+    # block costs a page but not a prefill.
+    host_pages: int
 
     @property
     def fresh_pages(self) -> int:
@@ -143,6 +146,14 @@ class KvFootprintForecast:
             resident = len(self.kv.match_resident_hashes(hashes))
             if self.kv.host_pool is not None:
                 host = len(self.kv.host_pool.match_chain(hashes[resident:]))
+            if self.kv.g3_store is not None:
+                # Persistent-store extension: restorable (G3→G2→G1) just
+                # like a host hit — the forecast must see a restarted
+                # process's warm cache or packing would defer the very
+                # sequences whose prefixes survived.
+                host += len(
+                    self.kv.g3_store.match_chain(hashes[resident + host :])
+                )
         return SeqForecast(total, resident, host)
 
 

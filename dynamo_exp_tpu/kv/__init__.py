@@ -10,8 +10,13 @@ token runs shared by three consumers:
   (:mod:`dynamo_exp_tpu.kv_router.indexer`), and
 - the cluster simulator's shared-prefix residency model
   (:mod:`dynamo_exp_tpu.sim`).
+
+``PersistentKvStore`` is the crash-survivable G3 tier keyed by the same
+chained block hashes (docs/fault_tolerance.md "Durable KV & corruption
+containment").
 """
 
+from .persistent import PersistentKvStore
 from .prefix import PrefixIndex
 
-__all__ = ["PrefixIndex"]
+__all__ = ["PersistentKvStore", "PrefixIndex"]

@@ -445,6 +445,19 @@ def build_parser() -> argparse.ArgumentParser:
         "offload under KV pressure; 0 = reactive baseline)",
     )
     sim.add_argument(
+        "--g3-pages", type=int, default=0,
+        help="modeled durable G3 store pages per instance (docs/"
+        "fault_tolerance.md 'Durable KV & corruption containment'; "
+        "evicted cold blocks demote there and survive --restart-at-s; "
+        "0 = G2-only baseline)",
+    )
+    sim.add_argument(
+        "--restart-at-s", type=float, default=None,
+        help="restart drill: hard-restart the busiest instance at this "
+        "sim time (journal failover for in-flight work; respawns on "
+        "the same modeled G3 disk after the provision delay)",
+    )
+    sim.add_argument(
         "--no-kv-packing", action="store_true",
         help="first-fit admission baseline (disable footprint-packed "
         "admission)",
@@ -1212,16 +1225,39 @@ def run_audit(args) -> int:
         + f"  held={audit.get('held_pages', '?')}"
         f"  ref_total={audit.get('ref_total', '?')}"
     )
+    # G3 persistent tier (docs/fault_tolerance.md "Durable KV &
+    # corruption containment"): present only when the engine ran with
+    # a store configured — its own O(1) conservation arithmetic rides
+    # in the same snapshot.
+    g3 = audit.get("g3")
+    g3_violations: list[str] = []
+    if isinstance(g3, dict):
+        print(
+            f"  g3 store: resident={g3.get('resident', 0)} "
+            f"adopted={g3.get('adopted', 0)} stores={g3.get('stores', 0)} "
+            f"evictions={g3.get('evictions', 0)} "
+            f"quarantined={g3.get('quarantined', 0)} "
+            f"checksum_failures={g3.get('checksum_failures', 0)} "
+            f"degraded={g3.get('degraded', False)}"
+        )
+        g3_violations = list(g3.get("violations") or [])
     violations = audit.get("violations", [])
-    if not violations:
+    if not violations and not g3_violations:
         print("  CONSERVED: every page accounted for, refcounts balance")
         return 0
-    print(f"  {len(violations)} VIOLATION(S):")
-    for v in violations:
-        page = v.get("page")
-        where = f"page {page}" if page is not None else "counters"
-        holders = ", ".join(v.get("holders") or []) or "no live holder"
-        print(f"    {where}: {v.get('kind')} — {v.get('detail')} [{holders}]")
+    if violations:
+        print(f"  {len(violations)} VIOLATION(S):")
+        for v in violations:
+            page = v.get("page")
+            where = f"page {page}" if page is not None else "counters"
+            holders = ", ".join(v.get("holders") or []) or "no live holder"
+            print(
+                f"    {where}: {v.get('kind')} — {v.get('detail')} [{holders}]"
+            )
+    if g3_violations:
+        print(f"  {len(g3_violations)} G3 VIOLATION(S):")
+        for s in g3_violations:
+            print(f"    {s}")
     return 1
 
 
@@ -1383,6 +1419,8 @@ def run_sim(args) -> int:
         prefix_sharing=not args.no_prefix_sharing,
         host_pages_per_instance=args.host_pages,
         kv_packing=not args.no_kv_packing,
+        g3_pages_per_instance=args.g3_pages,
+        restart_at_s=args.restart_at_s,
     )
     sim = ClusterSim(cfg, workload)
     report = sim.run()

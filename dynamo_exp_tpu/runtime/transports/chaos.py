@@ -224,6 +224,101 @@ class ChaosSchedule:
         await asyncio.sleep(fault.delay_s + jitter)
 
 
+class StorageChaos:
+    """Seeded storage-fault schedule for the G3 persistent KV tier
+    (docs/fault_tolerance.md "Durable KV & corruption containment").
+
+    Same consume-in-order contract as :class:`ChaosSchedule`, over the
+    store's two interception points — ``store_write`` (demotion /
+    shutdown drain) and ``store_read`` (promotion / re-attach fetch) —
+    with storage-flavoured kinds:
+
+    - ``enospc``: the write raises ``OSError(ENOSPC)`` → the store
+      counts it and flips :attr:`~dynamo_exp_tpu.kv.persistent.PersistentKvStore.degraded`
+      (engine falls back to G2-only, never a stall).
+    - ``torn``: the page file lands as a truncated prefix of the real
+      bytes — the power-cut-mid-write shape ``boot_scan`` and the fetch
+      checksum must both reject.
+    - ``bitflip``: one payload byte of the *read* is flipped at a
+      position drawn from the seeded rng — fetch must checksum-fail,
+      quarantine, and miss; never serve the garbage.
+    - ``delay``: the read sleeps ``delay_s`` first — a slow SSD must
+      slow restores, never wedge the engine loop.
+
+    The fifth family member — store-dir missing — needs no schedule: it
+    is exercised by constructing the store over an uncreatable path.
+    Every fired fault lands in :attr:`injected` for assertions.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.faults: list[Fault] = []
+        self.injected: list[str] = []
+
+    # ------------------------------------------------------------ script
+    def add(self, fault: Fault) -> "StorageChaos":
+        assert fault.op in ("store_write", "store_read")
+        self.faults.append(fault)
+        return self
+
+    def enospc(self, times: int = 1) -> "StorageChaos":
+        return self.add(
+            Fault(
+                "store_write",
+                kind="enospc",
+                times=times,
+                message="chaos: no space left on device",
+            )
+        )
+
+    def torn_write(self, times: int = 1) -> "StorageChaos":
+        return self.add(
+            Fault(
+                "store_write",
+                kind="torn",
+                times=times,
+                message="chaos: torn page write",
+            )
+        )
+
+    def bitflip_read(self, times: int = 1) -> "StorageChaos":
+        return self.add(
+            Fault(
+                "store_read",
+                kind="bitflip",
+                times=times,
+                message="chaos: bit flipped in stored page",
+            )
+        )
+
+    def delay_read(self, delay_s: float, times: int = 1) -> "StorageChaos":
+        return self.add(
+            Fault(
+                "store_read",
+                kind="delay",
+                delay_s=delay_s,
+                times=times,
+                message="chaos: slow store read",
+            )
+        )
+
+    def clear(self) -> "StorageChaos":
+        self.faults.clear()
+        return self
+
+    # ----------------------------------------------------------- consume
+    def take(self, op: str) -> Fault | None:
+        for f in self.faults:
+            if f.op != op or f.times == 0:
+                continue
+            if f.times > 0:
+                f.times -= 1
+            self.injected.append(f"{op}:{f.kind}")
+            return f
+        return None
+
+
 @dataclass
 class BurstRequest:
     """One request of a seeded overload burst (see :func:`overload_burst`)."""

@@ -117,6 +117,22 @@ class SimConfig:
     packing_max_defers: int = 64
     host_pages_per_instance: int = 0
     proactive_offload: bool = True
+    # Durable G3 KV (docs/fault_tolerance.md "Durable KV & corruption
+    # containment"): a modeled per-host persistent page store fed by
+    # parked-shared-block evictions (the live HostKvPool.on_demote ->
+    # PersistentKvStore.store path), FIFO-bounded at this many pages.
+    # A prefix_group admission whose radix match falls short extends
+    # its warm-prefill credit with store-resident chain blocks, billed
+    # at g3_restore_s_per_page each instead of their prefill compute.
+    # 0 pages = no store (G2-only baseline).
+    g3_pages_per_instance: int = 0
+    g3_restore_s_per_page: float = 0.0005
+    # Restart drill: at this sim time the busiest instance hard-
+    # restarts (power cut — no reclaim grace): in-flight work journals
+    # over to survivors, the host respawns after provision_s on the
+    # SAME modeled disk, and its G3 store re-adopts (the live
+    # boot_scan), so returning prefix groups re-attach warm.
+    restart_at_s: float | None = None
     # Fleet.
     initial_instances: int = 1
     provision_s: float | None = None  # None -> service model's value
@@ -171,6 +187,9 @@ class _SimSeq:
         # Spot reclamation: True while this life is a live-migrated
         # continuation whose cache credit is still unconsumed.
         "migrated",
+        # Durable G3 KV: modeled store-fetch seconds owed for the
+        # G3-restored share of cached_tokens (billed with the credit).
+        "g3_restore_s",
     )
 
     def __init__(self, req: SimRequest, now: float):
@@ -210,6 +229,7 @@ class _SimSeq:
         self.preempted_at = 0.0
         self.decode_began = 0.0
         self.migrated = False
+        self.g3_restore_s = 0.0
 
 
 class _SimInstance:
@@ -217,7 +237,7 @@ class _SimInstance:
         "id", "cfg", "waiting", "bound", "stall_queue", "pages_free",
         "metrics", "draining", "prefix_index", "shared_refs", "parked",
         "born_at", "preemptions", "host_free", "swap_queue",
-        "spot", "topo",
+        "spot", "topo", "g3",
     )
 
     def __init__(self, iid: int, cfg: SimConfig, now: float):
@@ -248,6 +268,11 @@ class _SimInstance:
         self.prefix_index = PrefixIndex()
         self.shared_refs: dict[int, int] = {}
         self.parked: dict[int, None] = {}  # insertion order = LRU
+        # Durable G3 KV: the modeled persistent store (insertion order
+        # = FIFO eviction at g3_pages_per_instance). Dies with the host
+        # on reclaim/retire; the restart drill hands it to the respawn
+        # (same disk, live boot_scan re-adoption).
+        self.g3: dict[int, None] = {}
         # One mutable metrics object per instance: the router reads it
         # in place (no per-arrival allocation at fleet scale).
         self.metrics = ForwardPassMetrics(
@@ -382,6 +407,14 @@ class ClusterSim:
             del inst.parked[h]
             inst.prefix_index.remove(h)
             self._shared_resident -= 1
+            # Durable G3 KV: the evicted cold block demotes to the
+            # modeled persistent store (live HostKvPool.on_demote),
+            # refreshed to the FIFO tail if already resident.
+            if self.cfg.g3_pages_per_instance > 0:
+                inst.g3.pop(h, None)
+                inst.g3[h] = None
+                while len(inst.g3) > self.cfg.g3_pages_per_instance:
+                    inst.g3.pop(next(iter(inst.g3)))
 
     def _release_shared(self, inst: _SimInstance, seq: _SimSeq) -> None:
         """Drop the sequence's refs on its shared blocks; zero-ref
@@ -454,7 +487,23 @@ class ClusterSim:
         seq.shared_hashes = matched + new
         seq.shared_page_count = n_shared
         seq.pages = total
-        seq.cached_tokens = min(len(matched) * ps, seq.prompt_len - 1)
+        # Durable G3 KV: blocks past the radix match whose chain
+        # continues in the modeled persistent store restore instead of
+        # re-prefilling — credit extends over them, billed at
+        # g3_restore_s_per_page each when the credit is consumed
+        # (the live G3 fetch -> G2 promote on the admission path).
+        g3_restored = 0
+        if cfg.g3_pages_per_instance > 0:
+            for h in new:
+                if h not in inst.g3:
+                    break
+                g3_restored += 1
+        seq.cached_tokens = min(
+            (len(matched) + g3_restored) * ps, seq.prompt_len - 1
+        )
+        if g3_restored:
+            seq.g3_restore_s = g3_restored * cfg.g3_restore_s_per_page
+            self.report.g3_restored_pages += g3_restored
         if cow:
             self.report.cow_copies += 1
             seq.cached_tokens = seq.prompt_len - 1
@@ -695,10 +744,17 @@ class ClusterSim:
             # Cache credit applies on first admission (router overlap)
             # or when a live migration just parked this life's prefix on
             # this instance; the credit is consumed here exactly once.
+            restore_s = 0.0
             if seq.cached_tokens and (seq.preemptions == 0 or seq.migrated):
                 prefill_tokens = max(seq.prompt_len - seq.cached_tokens, 1)
+                # G3-restored blocks skip prefill compute but pay the
+                # modeled store-fetch time, serialized ahead of the
+                # residual prefill (the live restore-before-compute
+                # upload ordering).
+                restore_s = seq.g3_restore_s
             seq.migrated = False
-            delay = cfg.service.prefill_time(
+            seq.g3_restore_s = 0.0
+            delay = restore_s + cfg.service.prefill_time(
                 prefill_tokens, self.rng_service
             )
             self.loop.after(delay, self._on_prefill_done, seq, seq.epoch)
@@ -1130,6 +1186,7 @@ class ClusterSim:
         seq.instance = None
         seq.cached_tokens = 0
         seq.migrated = False
+        seq.g3_restore_s = 0.0
         return gen
 
     def _least_loaded(self) -> "_SimInstance | None":
@@ -1303,6 +1360,50 @@ class ClusterSim:
         self._provisioning_spot -= 1
         self._spawn_ready(spot=True)
 
+    # ------------------------------------------------------ restart drill
+    def _start_restart_drill(self) -> None:
+        if self.cfg.restart_at_s is not None:
+            self.loop.after(self.cfg.restart_at_s, self._on_restart)
+
+    def _on_restart(self) -> None:
+        """Hard restart drill: the busiest instance dies with NO grace
+        (power cut, not a reclaim notice) — in-flight work journals
+        over to survivors, the host respawns after provision_s on the
+        SAME modeled disk, and its G3 store re-adopts (the live
+        boot_scan), so returning prefix groups re-attach warm."""
+        live = self._routable()
+        if not live:
+            return
+        inst = max(live, key=lambda i: (len(i.bound) + len(i.waiting), i.id))
+        self.report.restarts += 1
+        self._log(
+            "instance %d hard restart (%d g3 pages survive)",
+            inst.id, len(inst.g3),
+        )
+        inst.draining = True  # out of routing before failovers reroute
+        g3 = inst.g3
+        for seq in list(inst.bound) + list(inst.waiting):
+            self._failover(seq)
+        if inst.id in self.instances:  # _finish may have retired it
+            self._retire(inst)
+        self._account_chips()
+        self._provisioning += 1
+        delay = (
+            self.cfg.provision_s
+            if self.cfg.provision_s is not None
+            else self.cfg.service.provision_s
+        )
+        self.loop.after(delay, self._on_restart_ready, g3)
+
+    def _on_restart_ready(self, g3: dict) -> None:
+        self._account_chips()
+        self._provisioning -= 1
+        inst = self._spawn_ready()
+        inst.g3 = g3
+        self._log(
+            "instance %d restarted, adopted %d g3 pages", inst.id, len(g3)
+        )
+
     # ------------------------------------------------------------- planner
     def _start_planner(self) -> None:
         if self.cfg.planner is None:
@@ -1389,6 +1490,7 @@ class ClusterSim:
         self._schedule_next_arrival()
         self._start_planner()
         self._start_reclaims()
+        self._start_restart_drill()
         self.loop.run(max_events=self.cfg.max_events)
         self._account_chips()
         r = self.report

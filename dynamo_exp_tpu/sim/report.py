@@ -78,6 +78,13 @@ class SimReport:
     reclaim_migrated: int = 0
     reclaim_failovers: int = 0
     reclaim_migrated_pages: int = 0
+    # Durable G3 KV (docs/fault_tolerance.md "Durable KV & corruption
+    # containment"): hard-restart drills served, and chain blocks
+    # restored from the modeled persistent store as admission cache
+    # credit (each billed g3_restore_s_per_page instead of its prefill
+    # compute) — warm-restart TTFT recovery is the headline.
+    restarts: int = 0
+    g3_restored_pages: int = 0
     billed_chip_seconds: float = 0.0
     max_instances: int = 0
     chip_seconds: float = 0.0

@@ -216,9 +216,10 @@ class Telemetry:
         self.kv_prefix_hits = Counter(
             "dynamo_kv_prefix_hits_total",
             "Prompt pages at admission by source: shared (G1 attach, "
-            "refcounted), restore (G2 host-tier upload), miss (fresh "
-            "prefill)",
-            ["kind"],  # shared | restore | miss
+            "refcounted), restore (G2 host-tier upload), persist (G3 "
+            "persistent-store restore — the restart re-attachment "
+            "path), miss (fresh prefill)",
+            ["kind"],  # shared | restore | persist | miss
             registry=self.registry,
         )
         # Predictive KV tiering (docs/engine_perf.md "Predictive KV
@@ -228,6 +229,31 @@ class Telemetry:
             "dynamo_kv_host_pages",
             "G2 host-tier KV pages currently resident (HostKvPool "
             "occupancy — fleet views read host-tier pressure here)",
+            registry=self.registry,
+        )
+        # G3 persistent tier (docs/fault_tolerance.md "Durable KV &
+        # corruption containment"): occupancy plus the corruption-
+        # containment counters (checksum failures by path, quarantines).
+        self.kv_store_pages = Gauge(
+            "dynamo_kv_store_pages",
+            "G3 persistent-store KV pages currently resident "
+            "(PersistentKvStore occupancy)",
+            registry=self.registry,
+        )
+        self.kv_checksum_failures = Counter(
+            "dynamo_kv_checksum_failures_total",
+            "KV pages that failed checksum verification on a restore "
+            "path: store (G3 fetch) or wire (disagg inject / reclaim "
+            "migration sink) — each one was quarantined or failed the "
+            "transfer, never served",
+            ["path"],  # store | wire
+            registry=self.registry,
+        )
+        self.kv_quarantined = Counter(
+            "dynamo_kv_quarantined_total",
+            "G3 store pages moved to quarantine after failing "
+            "verification (the entry is barred from re-adoption; the "
+            "block re-prefills from the journal, token-identically)",
             registry=self.registry,
         )
         self.kv_prefetch_pages = Counter(

@@ -153,6 +153,9 @@ NON_TUNABLE: dict[str, frozenset] = {
         # Observability plane (flight ring, watchdog, leases).
         "flight_events", "flight_capacity", "watchdog_stall_s",
         "kv_lease_ttl_s",
+        # Durable G3 tier capacity: sized to the local SSD, a
+        # provisioning decision like max_tpu_budget, not a perf knob.
+        "kv_store_pages",
         # Speculation is tuned online by the adaptive controller
         # (spec/controller.py); static search would fight it.
         "spec_draft_len", "spec_min_draft", "spec_max_draft",
@@ -183,6 +186,9 @@ NON_TUNABLE: dict[str, frozenset] = {
         "reclaim_grace_s", "reclaim_margin_s", "migration_bw_bps",
         "kv_bytes_per_page", "spot_cost_factor", "record_events",
         "max_events",
+        # Durable-KV restart drill (docs/fault_tolerance.md): store
+        # capacity / restore-cost model parameters, not perf knobs.
+        "g3_pages_per_instance", "g3_restore_s_per_page",
     }),
 }
 

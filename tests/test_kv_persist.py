@@ -1,0 +1,575 @@
+"""Durable G3 KV tier tests (docs/fault_tolerance.md "Durable KV &
+corruption containment"): PersistentKvStore crash-consistency units
+(atomic writes, torn-tail detection, manifest replay, quarantine,
+degradation ladder, the O(1) conservation ledger), restart-identical
+prefix re-attachment end to end (hard-kill an engine mid-conversation,
+boot a fresh process over the same store, prove the persist hit and
+token identity — greedy and seeded), seeded storage-fault containment
+(``make chaos`` STORE_SEED_SETS: bit-flip, torn tail, ENOSPC, slow
+reads, missing store dir — no token from a corrupt page, no hangs,
+token-identical to fault-free), the stop()-drain regression (pending
+G2 demotions flush through the G3 writer, never past a wedged loop),
+the wire-checksum unit, and the sim restart drill.
+
+The identity proofs follow the tiering-suite pattern: counter-based
+sampling makes tokens a pure function of the request, and the G3 round
+trip is bit-exact under ``kv_dtype=float32`` — so a restored prefix
+must decode identically to recompute, and a quarantined page's journal
+re-prefill is token-identical by construction. The autouse conservation
+guard (tests/conftest.py) polices both the page ledger and the G3
+ledger (stop() folds ``g3_store.ledger_check()`` into the audit).
+"""
+
+import asyncio
+import os
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from dynamo_exp_tpu.engine import EngineConfig, TPUEngine
+from dynamo_exp_tpu.kv.persistent import _HEADER, PersistentKvStore
+from dynamo_exp_tpu.models import TINY
+from dynamo_exp_tpu.parallel import single_device_mesh
+from dynamo_exp_tpu.protocols.common import BackendInput
+from dynamo_exp_tpu.runtime.transports.chaos import StorageChaos
+
+PS = 8
+
+CHAOS_SEEDS = [
+    int(s) for s in os.environ.get("CHAOS_SEEDS", "7").split(",")
+]
+
+# ------------------------------------------------------------- store units
+SHAPE = (2, 4, 8)
+
+
+def _store(root, cap=8, chaos=None):
+    return PersistentKvStore(str(root), cap, SHAPE, np.float32, chaos=chaos)
+
+
+def _page(i):
+    return (
+        np.full(SHAPE, float(i), np.float32),
+        np.full(SHAPE, float(-i), np.float32),
+    )
+
+
+def test_store_roundtrip_refresh_and_lru_eviction(tmp_path):
+    st = _store(tmp_path, cap=2)
+    assert st.store(1, *_page(1))
+    assert st.store(2, *_page(2))
+    k, v = st.fetch(1)
+    np.testing.assert_array_equal(k, _page(1)[0])
+    np.testing.assert_array_equal(v, _page(1)[1])
+    assert st.hits == 1
+    # Re-store of a resident hash refreshes, never duplicates.
+    assert st.store(1, *_page(1))
+    assert st.refreshes == 1 and st.stores == 2
+    # Third page over a 2-page capacity: insertion-order LRU evicts the
+    # coldest (hash 2 — hash 1 was refreshed above), file and all.
+    assert st.store(3, *_page(3))
+    assert st.evictions == 1
+    assert st.fetch(2) is None and st.misses == 1
+    assert 2 not in st and 1 in st and 3 in st
+    assert st.ledger_check() == []
+    st.close()
+
+
+def test_match_chain_is_contiguous_prefix_only(tmp_path):
+    st = _store(tmp_path)
+    for h in (10, 11, 13):  # 12 never stored: the chain has a hole
+        st.store(h, *_page(h))
+    assert st.match_chain([10, 11, 12, 13]) == [10, 11]
+    assert st.match_chain([12, 13]) == []
+    assert st.match_chain([]) == []
+    st.close()
+
+
+def test_boot_scan_adopts_survivors_and_quarantines_torn_tail(tmp_path):
+    st = _store(tmp_path)
+    for h in (1, 2, 3):
+        st.store(h, *_page(h))
+    st.close()
+    # Power-cut emulation: hash 2's file survives as a torn prefix.
+    victim = os.path.join(str(tmp_path), f"{2:016x}.kv")
+    blob = open(victim, "rb").read()
+    with open(victim, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    st2 = _store(tmp_path)
+    assert st2.boot_scan() == 2
+    assert st2.torn_pages == 1
+    assert st2.adopted == 2
+    # The torn file moved aside for forensics, never adoptable.
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "quarantine", f"{2:016x}.kv")
+    )
+    # The hole detaches the chain suffix exactly like a G2 miss would.
+    assert st2.match_chain([1, 2, 3]) == [1]
+    k, _v = st2.fetch(1)
+    np.testing.assert_array_equal(k, _page(1)[0])
+    assert st2.ledger_check() == []
+    st2.close()
+
+
+def test_boot_scan_sweeps_tmp_orphans(tmp_path):
+    st = _store(tmp_path)
+    st.store(1, *_page(1))
+    st.close()
+    # A crash between the tmp write and the rename leaves an orphan the
+    # publish rename never blessed: boot must clear, never adopt, it.
+    orphan = os.path.join(str(tmp_path), f"{9:016x}.kv.tmp")
+    with open(orphan, "wb") as f:
+        f.write(b"half a page")
+    st2 = _store(tmp_path)
+    assert st2.boot_scan() == 1
+    assert not os.path.exists(orphan)
+    assert 9 not in st2
+    st2.close()
+
+
+def test_boot_scan_tolerates_torn_manifest_tail(tmp_path):
+    st = _store(tmp_path)
+    for h in (1, 2):
+        st.store(h, *_page(h))
+    st.close()
+    with open(os.path.join(str(tmp_path), "manifest.jsonl"), "a") as f:
+        f.write('{"op": "put", "ha')  # crash mid-append
+    st2 = _store(tmp_path)
+    assert st2.boot_scan() == 2
+    assert st2.manifest_torn == 1
+    assert st2.match_chain([1, 2]) == [1, 2]
+    st2.close()
+
+
+def test_bitflip_fetch_quarantines_and_bars_readmission(tmp_path):
+    chaos = StorageChaos(7).bitflip_read(times=1)
+    st = _store(tmp_path, chaos=chaos)
+    st.store(5, *_page(5))
+    # The flipped read must checksum-fail: no garbage bytes served.
+    assert st.fetch(5) is None
+    assert st.checksum_failures == 1
+    assert st.quarantined == 1 and st.misses == 1
+    assert chaos.injected == ["store_read:bitflip"]
+    names = os.listdir(os.path.join(str(tmp_path), "quarantine"))
+    assert names == [f"{5:016x}.kv"]
+    # A proven-corrupt key is terminal: no re-store, no re-match.
+    assert not st.store(5, *_page(5))
+    assert st.match_chain([5]) == []
+    assert st.fetch(5) is None
+    assert st.ledger_check() == []
+    # Nor does a later boot re-adopt it (the journal remembers).
+    st.close()
+    st2 = _store(tmp_path)
+    assert st2.boot_scan() == 0
+    assert st2.match_chain([5]) == []
+    st2.close()
+
+
+def test_enospc_degrades_to_noop_writes(tmp_path):
+    chaos = StorageChaos(3).enospc(times=1)
+    st = _store(tmp_path, chaos=chaos)
+    assert not st.store(1, *_page(1))
+    assert st.degraded and st.store_errors == 1
+    # Degradation is sticky: later (fault-free) writes stay no-ops and
+    # reads stay safe misses — G2-only behavior, never an exception.
+    assert not st.store(2, *_page(2))
+    assert st.fetch(1) is None
+    assert st.resident == 0
+    assert st.ledger_check() == []
+    st.close()
+
+
+def test_uncreatable_root_degrades_at_construction(tmp_path):
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("a file where the store dir should go")
+    st = _store(blocker / "g3")
+    assert st.degraded
+    assert not st.store(1, *_page(1))
+    assert st.fetch(1) is None
+    assert st.boot_scan() == 0
+    assert st.match_chain([1]) == []
+    assert st.ledger_check() == []
+    st.close()
+
+
+def test_ledger_conservation_across_all_transitions(tmp_path):
+    chaos = StorageChaos(11).bitflip_read(times=1)
+    st = _store(tmp_path, cap=2, chaos=chaos)
+    for h in (1, 2, 3):  # one capacity eviction
+        st.store(h, *_page(h))
+    st.fetch(2)  # bit-flipped: quarantine
+    st.store(2, *_page(2))  # refused
+    st.store(4, *_page(4))  # readmit up to capacity
+    led = st.ledger()
+    assert led["violations"] == []
+    assert led["resident"] == (
+        led["adopted"] + led["stores"] - led["evictions"] - led["quarantined"]
+    )
+    assert led["resident"] == 2 and led["quarantined"] == 1
+    st.close()
+    # Survivors adopt; the ledger equation holds in the next process.
+    st2 = _store(tmp_path, cap=2)
+    st2.boot_scan()
+    assert st2.adopted == 2
+    assert st2.ledger()["violations"] == []
+    st2.close()
+
+
+# --------------------------------------------------------- wire checksums
+def test_wire_checksum_rejects_corrupt_frame():
+    from dynamo_exp_tpu.disagg import transfer
+
+    pages = [_page(1), _page(2)]
+    header, payload = transfer.encode_pages(pages)
+    assert len(header["sums"]) == 2
+    out = transfer.decode_pages(header, payload)
+    np.testing.assert_array_equal(out[1][1], pages[1][1])
+    before = transfer.wire_checksum_failures()
+    corrupt = bytearray(payload)
+    corrupt[len(corrupt) // 2] ^= 0x10
+    with pytest.raises(ValueError, match="wire checksum"):
+        transfer.decode_pages(header, bytes(corrupt))
+    assert transfer.wire_checksum_failures() == before + 1
+    # Older senders omit sums: their frames still decode (no checksum).
+    legacy = dict(header)
+    del legacy["sums"]
+    assert len(transfer.decode_pages(legacy, payload)) == 2
+
+
+def test_page_file_header_crc_covers_meta_and_payload(tmp_path):
+    st = _store(tmp_path)
+    st.store(1, *_page(1))
+    blob = open(os.path.join(str(tmp_path), f"{1:016x}.kv"), "rb").read()
+    _magic, crc, _h, _meta_len = _HEADER.unpack_from(blob)
+    assert crc == zlib.crc32(blob[_HEADER.size:])
+    st.close()
+
+
+# -------------------------------------------------------------- engine e2e
+def make_engine(store_dir=None, pages=20, host_pages=6, slots=2,
+                store_pages=256, chaos=None, **kw):
+    cfg = EngineConfig(
+        model=TINY,
+        max_decode_slots=slots,
+        page_size=PS,
+        num_pages=pages,
+        max_model_len=256,
+        eos_token_ids=[],
+        prefix_sharing=True,
+        host_cache_pages=host_pages,
+        kv_dtype="float32",  # bit-exact across G2/G3 round trips
+        kv_store_dir="" if store_dir is None else str(store_dir),
+        kv_store_pages=store_pages,
+        kv_store_chaos=chaos,
+        **kw,
+    )
+    return TPUEngine(cfg, mesh=single_device_mesh(), seed=0)
+
+
+async def run_req(engine, prompt, n=6, seed=None, temperature=None):
+    b = BackendInput(token_ids=list(prompt))
+    b.stop_conditions.max_tokens = n
+    b.stop_conditions.ignore_eos = True
+    if seed is not None:
+        b.sampling_options.seed = seed
+    if temperature is not None:
+        b.sampling_options.temperature = temperature
+    stream = await engine.generate(b.to_dict())
+    tokens = []
+    async for item in stream:
+        tokens.extend(item.get("token_ids", []))
+    return tokens
+
+
+def _hard_kill(engine):
+    """Crash emulation: the loop thread dies with NO graceful teardown
+    — no offload flush, no G2→G3 snapshot drain, no manifest seal or
+    close. Whatever the demotion write-through already committed is all
+    the next boot gets, exactly like a power cut. Helper threads are
+    reaped so the test process stays clean."""
+    engine._running = False
+    engine._wake.set()
+    if engine._watchdog is not None:
+        engine._watchdog.stop()
+        engine._watchdog = None
+    if engine._thread is not None:
+        engine._thread.join(timeout=30)
+        assert not engine._thread.is_alive()
+        engine._thread = None
+    if engine.copy_stream is not None:
+        engine.copy_stream.stop()
+        engine.copy_stream = None
+
+
+def _convo_and_churn(seed, n_churn=2):
+    rs = np.random.RandomState(seed)
+    convo = [int(x) for x in rs.randint(3, 200, size=3 * PS)]
+    # Near-pool-sized churn prompts: each one forces the parked
+    # conversation pages G1→G2, and the small G2 overflows the oldest
+    # of them through the demotion write-through into G3.
+    churn = [
+        [int(x) for x in rs.randint(3, 200, size=16 * PS)]
+        for _ in range(n_churn)
+    ]
+    return convo, churn
+
+
+async def _seed_store(engine, convo, churn, n=6, **sampling):
+    """Run the conversation, then enough distinct churn that its parked
+    pages fall G1→G2 and overflow the small G2 into the G3 writer."""
+    want = await run_req(engine, convo, n=n, **sampling)
+    for p in churn:
+        await run_req(engine, p, n=2)
+    return want
+
+
+async def test_restart_resume_identity_greedy(tmp_path):
+    """The headline: kill an engine mid-conversation (no graceful
+    drain), boot a fresh process over the same store directory, and the
+    returning conversation re-attaches its persisted prefix — proven by
+    the persist hit counter — emitting exactly the pre-crash tokens."""
+    convo, churn = _convo_and_churn(7)
+    eng = make_engine(store_dir=tmp_path / "g3")
+    eng.start()
+    want = await _seed_store(eng, convo, churn)
+    # The demotion write-through put pages on disk BEFORE the crash.
+    assert eng.g3_store.resident > 0
+    _hard_kill(eng)
+    eng2 = make_engine(store_dir=tmp_path / "g3")
+    assert eng2.g3_store.adopted > 0  # boot_scan rebuilt the survivors
+    eng2.start()
+    try:
+        got = await run_req(eng2, convo, n=6)
+        assert got == want
+        assert eng2.kv.prefix_hits["persist"] > 0
+        m = eng2.metrics()
+        assert m["kv_prefix_hits_persist"] > 0
+        assert m["kv_store_promotes"] > 0
+        assert m["kv_store_checksum_failures"] == 0
+        assert m["kv_store_degraded"] == 0
+        audit = eng2.kv_audit()
+        assert audit["ok"], audit["violations"]
+        assert audit["g3"]["violations"] == []
+    finally:
+        eng2.stop()
+
+
+async def test_restart_resume_identity_seeded(tmp_path):
+    convo, churn = _convo_and_churn(11)
+    sampling = dict(seed=123, temperature=0.8)
+    eng = make_engine(store_dir=tmp_path / "g3")
+    eng.start()
+    want = await _seed_store(eng, convo, churn, n=8, **sampling)
+    assert eng.g3_store.resident > 0
+    _hard_kill(eng)
+    eng2 = make_engine(store_dir=tmp_path / "g3")
+    eng2.start()
+    try:
+        # Counter-based sampling keys on absolute position: restored
+        # pages shift nothing, the sampled stream replays exactly.
+        got = await run_req(eng2, convo, n=8, **sampling)
+        assert got == want
+        assert eng2.kv.prefix_hits["persist"] > 0
+    finally:
+        eng2.stop()
+
+
+async def test_stop_drains_pending_g2_demotions_through_g3(tmp_path):
+    """Graceful shutdown: the whole warm G2 set demotes through the G3
+    writer and the sealed manifest covers it — the next boot adopts a
+    cache as warm as the stopped process was. A wedged loop skips the
+    drain (teardown must never race a live loop thread)."""
+    convo, churn = _convo_and_churn(13, n_churn=3)
+    # Roomy G2: churn parks pages in the host pool without overflowing
+    # them into G3 — stop() is what must flush them.
+    eng = make_engine(store_dir=tmp_path / "g3", host_pages=64)
+    eng.start()
+    await _seed_store(eng, convo, churn)
+    assert eng.host_pool.resident > 0
+    warm_g2 = eng.host_pool.resident
+    before = eng.g3_store.stores
+
+    # A wedged loop thread must make stop() skip the teardown flush
+    # entirely: no drain, no seal, state untouched for the live thread.
+    class _Wedged:
+        def join(self, timeout=None):
+            pass
+
+        def is_alive(self):
+            return True
+
+    real = eng._thread
+    eng._thread = _Wedged()
+    eng.stop()
+    assert eng.g3_store.stores == before
+    assert eng.copy_stream is not None  # teardown never ran
+
+    # The real loop exited on stop()'s _running=False; a second stop
+    # with the joinable thread restored performs the full drain.
+    eng._thread = real
+    eng.stop()
+    assert eng.g3_store.stores >= before + warm_g2
+    drained = eng.g3_store.resident
+    # The sealed manifest makes every drained page adoptable.
+    eng2 = make_engine(store_dir=tmp_path / "g3")
+    assert eng2.g3_store.adopted == drained
+    eng2.start()
+    eng2.stop()
+
+
+# ---------------------------------------------- seeded storage-fault family
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+async def test_corrupt_page_containment_token_identical(tmp_path, seed):
+    """Bit-flipped G3 pages at restart: not one token decodes from the
+    corrupt bytes — the fetch checksum-fails, quarantines, and the
+    block journal-re-prefills token-identically to the fault-free
+    restart. No hang, auditor green throughout."""
+    convo, churn = _convo_and_churn(seed)
+    eng = make_engine(store_dir=tmp_path / "g3")
+    eng.start()
+    want = await _seed_store(eng, convo, churn)
+    eng.stop()  # graceful: full drain + sealed manifest
+
+    chaos = StorageChaos(seed).bitflip_read(times=2)
+    eng2 = make_engine(store_dir=tmp_path / "g3", chaos=chaos)
+    assert eng2.g3_store.adopted > 0
+    eng2.start()
+    try:
+        got = await run_req(eng2, convo, n=6)
+        assert got == want  # identical despite the flipped pages
+        m = eng2.metrics()
+        assert m["kv_store_checksum_failures"] > 0
+        assert m["kv_store_quarantined"] > 0
+        # The shortened restore re-prefilled instead of serving garbage.
+        assert chaos.injected
+        audit = eng2.kv_audit()
+        assert audit["ok"], audit["violations"]
+        assert audit["g3"]["violations"] == []
+    finally:
+        eng2.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+async def test_torn_write_containment_token_identical(tmp_path, seed):
+    """Torn demotion writes (power-cut shape): the next boot's scan
+    quarantines the torn files, adopts the survivors, and the returning
+    conversation is token-identical — the holes just re-prefill."""
+    convo, churn = _convo_and_churn(seed + 1)
+    chaos = StorageChaos(seed).torn_write(times=2)
+    eng = make_engine(store_dir=tmp_path / "g3", chaos=chaos)
+    eng.start()
+    want = await _seed_store(eng, convo, churn)
+    assert chaos.injected  # the torn writes actually fired
+    _hard_kill(eng)
+    eng2 = make_engine(store_dir=tmp_path / "g3")
+    assert eng2.g3_store.torn_pages > 0
+    eng2.start()
+    try:
+        got = await run_req(eng2, convo, n=6)
+        assert got == want
+        assert eng2.metrics()["kv_store_torn"] > 0
+    finally:
+        eng2.stop()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+async def test_enospc_and_slow_reads_degrade_never_stall(tmp_path, seed):
+    """ENOSPC mid-demotion flips the store to G2-only no-ops; slow
+    fetches slow restores — neither wedges the engine loop, and both
+    runs complete token-identically to their own re-runs."""
+    convo, churn = _convo_and_churn(seed + 2, n_churn=4)
+    chaos = StorageChaos(seed).enospc(times=1)
+    eng = make_engine(store_dir=tmp_path / "g3", chaos=chaos)
+    eng.start()
+    try:
+        want = await _seed_store(eng, convo, churn)
+        assert eng.g3_store.degraded
+        m = eng.metrics()
+        assert m["kv_store_degraded"] == 1 and m["kv_store_errors"] >= 1
+        # G2-only behavior: the same prompt still replays identically.
+        assert await run_req(eng, convo, n=6) == want
+    finally:
+        t0 = time.monotonic()
+        eng.stop()  # drain over a degraded store: bounded no-op
+        assert time.monotonic() - t0 < 30.0
+
+    # Slow store reads: seeded delays on the restore path, zero hangs.
+    slow_dir = tmp_path / "slow"
+    eng3 = make_engine(store_dir=slow_dir)
+    eng3.start()
+    want3 = await _seed_store(eng3, convo, churn)
+    eng3.stop()
+    eng4 = make_engine(
+        store_dir=slow_dir, chaos=StorageChaos(seed).delay_read(0.02, times=3)
+    )
+    eng4.start()
+    try:
+        assert await run_req(eng4, convo, n=6) == want3
+    finally:
+        eng4.stop()
+
+
+@pytest.mark.chaos
+async def test_missing_store_dir_runs_g2_only(tmp_path):
+    """The fifth family member: an uncreatable store directory degrades
+    at construction — the engine serves normally as G2-only."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    eng = make_engine(store_dir=blocker / "g3")
+    assert eng.g3_store.degraded
+    eng.start()
+    try:
+        convo, _ = _convo_and_churn(5, n_churn=0)
+        first = await run_req(eng, convo, n=6)
+        assert await run_req(eng, convo, n=6) == first
+        assert eng.metrics()["kv_store_degraded"] == 1
+    finally:
+        eng.stop()
+
+
+# -------------------------------------------------------------------- sim
+@pytest.mark.sim
+def test_sim_restart_drill_restores_g3_prefix_deterministically():
+    """The modeled restart drill: churn evicts the conversation's pages
+    into the instance's G3 dict, the drill hard-restarts the host (the
+    respawn inherits the dict — same disk), and the returning group
+    re-attaches restored pages. Bit-identical across same-seed runs."""
+    from dynamo_exp_tpu.sim import ClusterSim, SimConfig
+    from dynamo_exp_tpu.sim.workload import SimRequest
+
+    reqs = [
+        SimRequest(index=0, arrival_s=0.0, prompt_len=80, max_tokens=4,
+                   prefix_group=0, prefix_len=64),
+        SimRequest(index=1, arrival_s=5.0, prompt_len=500, max_tokens=4),
+        SimRequest(index=2, arrival_s=50.0, prompt_len=80, max_tokens=4,
+                   prefix_group=0, prefix_len=64),
+    ]
+
+    def run(g3_pages):
+        sim = ClusterSim(
+            SimConfig(
+                seed=9, slots_per_instance=4, pages_per_instance=32,
+                page_size=16, initial_instances=1, max_inflight=16,
+                prefix_sharing=True, g3_pages_per_instance=g3_pages,
+                restart_at_s=30.0, provision_s=5.0,
+            ),
+            list(reqs),
+        )
+        rep = sim.run()
+        return sim.event_log, rep
+
+    log1, rep1 = run(64)
+    assert rep1.restarts == 1
+    assert rep1.completed == 3 and rep1.errors == 0
+    assert rep1.g3_restored_pages >= 4
+    log2, rep2 = run(64)
+    assert log1 == log2
+    assert rep1.to_dict() == rep2.to_dict()
+    # Without the durable tier the drill loses the prefix entirely.
+    _log0, rep0 = run(0)
+    assert rep0.restarts == 1 and rep0.g3_restored_pages == 0
